@@ -1,0 +1,72 @@
+"""Benchmark harness: author-pairs/sec on the DBLP-large-scale APVPA job.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline (BASELINE.md): the reference Spark+GraphFrames run sustains
+≈0.0089 author-pairs/sec on dblp_large (111.9 s per pairwise stage, mean
+over the 81 logged stages). dblp_large.gexf is missing from the reference
+checkout, so we benchmark on a synthetic DBLP-large-scale HIN (10k
+authors — comfortably larger than dblp_large's observable author count of
+~770+ from the log prefix; venue/paper ratios match dblp_small) and
+measure end-to-end all-pairs throughput: encode → device → chain → scores
+for every author pair, including host↔device transfer of the results.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+BASELINE_PAIRS_PER_SEC = 1.0 / 111.9  # reference log, mean stage time
+
+N_AUTHORS = 10_000
+N_PAPERS = 14_000
+N_VENUES = 300
+
+
+def main() -> None:
+    import jax
+
+    from distributed_pathsim_tpu.backends.base import create_backend
+    from distributed_pathsim_tpu.data.synthetic import synthetic_hin
+    from distributed_pathsim_tpu.ops.metapath import compile_metapath
+
+    hin = synthetic_hin(N_AUTHORS, N_PAPERS, N_VENUES, seed=42)
+    mp = compile_metapath("APVPA", hin.schema)
+
+    def run_once() -> np.ndarray:
+        backend = create_backend("jax", hin, mp)
+        return backend.all_pairs_scores()
+
+    # warmup: compile + first execution
+    scores = run_once()
+    n = scores.shape[0]
+    assert scores.shape == (N_AUTHORS, N_AUTHORS)
+
+    # timed runs, end-to-end (fresh backend each time: host encode +
+    # device_put + compute + fetch)
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        scores = run_once()
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+
+    pairs = float(n) * (n - 1)  # ordered non-self pairs, the reference's unit
+    value = pairs / best
+    print(
+        json.dumps(
+            {
+                "metric": "author_pairs_per_sec_apvpa_10k_authors",
+                "value": value,
+                "unit": "pairs/sec",
+                "vs_baseline": value / BASELINE_PAIRS_PER_SEC,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,19 @@
+"""distributed_pathsim_tpu — TPU-native meta-path similarity framework.
+
+A from-scratch JAX/XLA/Pallas re-design of the capabilities of
+phamtheanhphu/Distributed-PathSim (Spark + GraphFrames PathSim over DBLP
+HINs): typed-HIN data model, metapath compiler, and dense / sharded /
+sparse / pallas execution backends computing commuting-matrix chains on
+TPU meshes.
+"""
+
+__version__ = "0.1.0"
+
+from .config import RunConfig  # noqa: F401
+from .data.schema import HINGraph, HINSchema  # noqa: F401
+from .data.encode import EncodedHIN, encode_hin  # noqa: F401
+from .data.gexf import read_gexf  # noqa: F401
+from .ops.metapath import MetaPath, compile_metapath  # noqa: F401
+from .backends.base import available_backends, create_backend  # noqa: F401
+from .driver import PathSimDriver  # noqa: F401
+from .engine import build, load_dataset  # noqa: F401

@@ -1,0 +1,111 @@
+"""Backend interface and registry.
+
+A backend binds an :class:`EncodedHIN` + compiled :class:`MetaPath` and
+serves the two primitives the reference's algorithm layer is built from
+(``DPathSim_APVPA.py:70-109``), batched:
+
+- ``global_walks()`` — the "global walk" count for EVERY source node at
+  once (row sums of the commuting matrix M; the reference runs one
+  distributed join per node for this)
+- ``pairwise_row(s)`` — ``M[s, :]``, the "pairwise walk" count from source
+  ``s`` to EVERY target at once (the reference runs one join per pair)
+
+plus all-pairs conveniences. The ``backend=`` flag of BASELINE.json routes
+through :func:`get_backend` / :func:`create_backend`.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Callable
+
+import numpy as np
+
+from ..data.encode import EncodedHIN
+from ..ops.metapath import MetaPath
+from ..ops import pathsim
+
+
+class PathSimBackend(abc.ABC):
+    """Common surface for all execution backends."""
+
+    name: str = "abstract"
+
+    def __init__(self, hin: EncodedHIN, metapath: MetaPath, **options: Any):
+        self.hin = hin
+        self.metapath = metapath
+        self.options = options
+
+    # -- primitives (each backend implements) -----------------------------
+
+    @abc.abstractmethod
+    def global_walks(self) -> np.ndarray:
+        """Row sums of M for every source node: float[N], integer-valued."""
+
+    @abc.abstractmethod
+    def pairwise_row(self, source_index: int) -> np.ndarray:
+        """M[source, :]: float[N], integer-valued."""
+
+    @abc.abstractmethod
+    def commuting_matrix(self) -> np.ndarray:
+        """The full M (dense). Backends for huge graphs may refuse."""
+
+    # -- derived ----------------------------------------------------------
+
+    def diagonal(self) -> np.ndarray:
+        return np.diagonal(self.commuting_matrix()).copy()
+
+    def _denominators(self, variant: str) -> np.ndarray:
+        if variant == "rowsum":
+            return self.global_walks()
+        if variant == "diagonal":
+            return self.diagonal()
+        raise ValueError(f"unknown variant {variant!r}")
+
+    def scores_from_source(
+        self, source_index: int, variant: str = "rowsum"
+    ) -> np.ndarray:
+        d = self._denominators(variant)
+        row = self.pairwise_row(source_index)
+        return pathsim.score_row(row, d[source_index], d, xp=np)
+
+    def all_pairs_scores(self, variant: str = "rowsum") -> np.ndarray:
+        m = self.commuting_matrix()
+        rowsums = self.global_walks() if variant == "rowsum" else None
+        return pathsim.score_matrix(m, rowsums=rowsums, variant=variant, xp=np)
+
+
+_REGISTRY: dict[str, Callable[..., PathSimBackend]] = {}
+
+
+def register_backend(name: str):
+    def deco(cls):
+        cls.name = name
+        _REGISTRY[name] = cls
+        return cls
+
+    return deco
+
+
+def get_backend(name: str) -> Callable[..., PathSimBackend]:
+    # Import side-effect registration for the built-ins on first use.
+    from . import numpy_backend, jax_dense, jax_sharded, jax_sparse  # noqa: F401
+
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown backend {name!r}; available: {sorted(_REGISTRY)}"
+        ) from None
+
+
+def available_backends() -> list[str]:
+    from . import numpy_backend, jax_dense, jax_sharded, jax_sparse  # noqa: F401
+
+    return sorted(_REGISTRY)
+
+
+def create_backend(
+    name: str, hin: EncodedHIN, metapath: MetaPath, **options: Any
+) -> PathSimBackend:
+    return get_backend(name)(hin, metapath, **options)

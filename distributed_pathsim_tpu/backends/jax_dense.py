@@ -1,0 +1,79 @@
+"""Single-device jit'd dense backend (BASELINE.json config 2).
+
+The minimum end-to-end TPU slice: the whole commuting-matrix chain is one
+jit-compiled program of staged matmuls on device. f32 throughout with f32
+accumulation — exact for integer path counts below 2²⁴ (dblp-scale row
+sums are ≤ ~1.2e4; validity is asserted, not assumed). ``highest``
+matmul precision keeps the MXU from silently dropping to bf16 inputs,
+which WOULD truncate counts ≥ 257 (SURVEY.md §7).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import chain
+from .base import PathSimBackend, register_backend
+
+# f32 represents every integer exactly up to 2**24.
+_F32_EXACT_INT_MAX = float(2**24)
+
+
+@functools.partial(jax.jit, static_argnames=("symmetric",))
+def _chain_outputs(blocks, symmetric: bool):
+    """Compute (M, rowsums) for the oriented chain on device.
+
+    ``highest`` matmul precision: counts are integers, bf16-pass matmuls
+    would truncate them.
+    """
+    with jax.default_matmul_precision("highest"):
+        if symmetric:
+            c = chain.half_product(blocks, xp=jnp)
+            m = jnp.matmul(c, c.T)
+            rowsums = chain.rowsums_from_half(c, xp=jnp)
+        else:
+            m = chain.chain_product(blocks, xp=jnp)
+            rowsums = jnp.sum(m, axis=1)
+    return m, rowsums
+
+
+@register_backend("jax")
+class JaxDenseBackend(PathSimBackend):
+    """Dense chain on one device (TPU when available, else host backend)."""
+
+    def __init__(self, hin, metapath, dtype=jnp.float32, device=None, **options):
+        super().__init__(hin, metapath, **options)
+        self.dtype = dtype
+        steps = metapath.half() if metapath.is_symmetric else metapath.steps
+        host_blocks = chain.oriented_dense_blocks(hin, steps, dtype=np.float32)
+        self._blocks = [
+            jax.device_put(jnp.asarray(b, dtype=dtype), device) for b in host_blocks
+        ]
+        self._symmetric = metapath.is_symmetric
+        self._m = None
+        self._rowsums = None
+
+    def _compute(self):
+        if self._m is None:
+            m, rowsums = _chain_outputs(self._blocks, self._symmetric)
+            self._m = np.asarray(m, dtype=np.float64)
+            self._rowsums = np.asarray(rowsums, dtype=np.float64)
+            if self.dtype == jnp.float32 and self._rowsums.max(initial=0.0) >= _F32_EXACT_INT_MAX:
+                raise OverflowError(
+                    "path counts exceed f32 exact-integer range (2^24); "
+                    "rerun with dtype=jnp.float64 (requires JAX_ENABLE_X64)"
+                )
+        return self._m, self._rowsums
+
+    def commuting_matrix(self) -> np.ndarray:
+        return self._compute()[0]
+
+    def global_walks(self) -> np.ndarray:
+        return self._compute()[1]
+
+    def pairwise_row(self, source_index: int) -> np.ndarray:
+        return self._compute()[0][source_index]

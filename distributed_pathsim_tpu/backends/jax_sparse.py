@@ -1,0 +1,133 @@
+"""Sparse/tiled backend (BASELINE.json config 5 path).
+
+For graphs where dense adjacency blocks (N×P) don't fit: the half-chain
+factor C is folded sparsely on the host (ops/sparse.py), then all device
+work is static-shaped scatter + tile GEMMs. Serves the same primitives as
+the dense backends at dblp scale, plus streaming ``topk`` over row tiles
+for graphs whose full N×N score matrix can't exist.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import sparse as sp
+from ..ops.metapath import MetaPath
+from .base import PathSimBackend, register_backend
+
+# Refuse to densify all-pairs outputs beyond this many entries (16k×16k
+# f64 ≈ 2 GB); larger graphs must use the streaming top-k path.
+_DENSE_M_MAX_ENTRIES = 1 << 28
+
+
+@register_backend("jax-sparse")
+class JaxSparseBackend(PathSimBackend):
+    def __init__(
+        self,
+        hin,
+        metapath: MetaPath,
+        tile_rows: int = 4096,
+        dtype=jnp.float32,
+        **options,
+    ):
+        super().__init__(hin, metapath, **options)
+        if not metapath.is_symmetric:
+            raise ValueError("jax-sparse requires a symmetric metapath")
+        coo_blocks = []
+        for st in metapath.half():
+            c = sp.coo_from_block(hin.block(st.relationship))
+            if st.reverse:
+                c = sp.COOMatrix(
+                    rows=c.cols, cols=c.rows, weights=c.weights,
+                    shape=(c.shape[1], c.shape[0]),
+                )
+            coo_blocks.append(c)
+        self._c = sp.fold_half_chain(coo_blocks)
+        self.n = self._c.shape[0]
+        self.tiled = sp.TiledHalfChain(
+            self._c, tile_rows=min(tile_rows, max(self.n, 8)), dtype=dtype
+        )
+        self._rowsums: np.ndarray | None = None
+        self._m: np.ndarray | None = None
+
+    def global_walks(self) -> np.ndarray:
+        if self._rowsums is None:
+            self._rowsums = self.tiled.rowsums()
+        return self._rowsums
+
+    def commuting_matrix(self) -> np.ndarray:
+        if self._m is None:
+            if self.n * self.n > _DENSE_M_MAX_ENTRIES:
+                raise MemoryError(
+                    f"dense M would be {self.n}x{self.n}; use topk_scores()"
+                )
+            t = self.tiled
+            m = np.zeros((t.n_tiles * t.tile_rows, t.n_tiles * t.tile_rows))
+            for i in range(t.n_tiles):
+                for j in range(i, t.n_tiles):
+                    tile = np.asarray(t.m_tile(i, j), dtype=np.float64)
+                    m[
+                        i * t.tile_rows : (i + 1) * t.tile_rows,
+                        j * t.tile_rows : (j + 1) * t.tile_rows,
+                    ] = tile
+                    if j != i:
+                        m[
+                            j * t.tile_rows : (j + 1) * t.tile_rows,
+                            i * t.tile_rows : (i + 1) * t.tile_rows,
+                        ] = tile.T
+            self._m = m[: self.n, : self.n]
+        return self._m
+
+    def pairwise_row(self, source_index: int) -> np.ndarray:
+        t = self.tiled
+        ti, off = divmod(source_index, t.tile_rows)
+        src_tile = t.tile(ti)
+        out = np.zeros(t.n_tiles * t.tile_rows, dtype=np.float64)
+        for j in range(t.n_tiles):
+            tile = np.asarray(
+                sp.tile_outer(src_tile[off : off + 1], t.tile(j)),
+                dtype=np.float64,
+            )
+            out[j * t.tile_rows : (j + 1) * t.tile_rows] = tile[0]
+        return out[: self.n]
+
+    def topk_scores(self, k: int = 10, variant: str = "rowsum"):
+        """Streaming per-source top-k over row tiles: never materializes
+        more than one [tile, tile] score block. Returns (values, indices)
+        arrays of shape [N, k]."""
+        if variant != "rowsum":
+            raise ValueError("streaming top-k supports the rowsum variant")
+        t = self.tiled
+        d = self.global_walks()
+        d_pad = np.zeros(t.n_tiles * t.tile_rows)
+        d_pad[: self.n] = d
+        vals = np.full((self.n, k), -np.inf)
+        idxs = np.zeros((self.n, k), dtype=np.int64)
+        for i in range(t.n_tiles):
+            i0 = i * t.tile_rows
+            di = d_pad[i0 : i0 + t.tile_rows]
+            best_v = np.full((t.tile_rows, k), -np.inf)
+            best_i = np.zeros((t.tile_rows, k), dtype=np.int64)
+            for j in range(t.n_tiles):
+                j0 = j * t.tile_rows
+                m_tile = np.asarray(t.m_tile(i, j), dtype=np.float64)
+                denom = di[:, None] + d_pad[None, j0 : j0 + t.tile_rows]
+                with np.errstate(invalid="ignore", divide="ignore"):
+                    s = np.where(denom > 0, 2.0 * m_tile / np.where(denom > 0, denom, 1), 0.0)
+                # mask self-pairs and column padding
+                cols = np.arange(j0, j0 + t.tile_rows)
+                s[:, cols >= self.n] = -np.inf
+                if i == j:
+                    np.fill_diagonal(s, -np.inf)
+                merged_v = np.concatenate([best_v, s], axis=1)
+                merged_i = np.concatenate(
+                    [best_i, np.broadcast_to(cols, s.shape)], axis=1
+                )
+                top = np.argsort(-merged_v, axis=1, kind="stable")[:, :k]
+                best_v = np.take_along_axis(merged_v, top, axis=1)
+                best_i = np.take_along_axis(merged_i, top, axis=1)
+            rows_here = min(t.tile_rows, self.n - i0)
+            vals[i0 : i0 + rows_here] = best_v[:rows_here]
+            idxs[i0 : i0 + rows_here] = best_i[:rows_here]
+        return vals, idxs

@@ -1,0 +1,57 @@
+"""NumPy f64 dense oracle (BASELINE.json config 1).
+
+The ground-truth backend: float64 keeps path counts exact far past f32's
+2²⁴ integer range (SURVEY.md §7 "Path counts are integers"). Every other
+backend is tested against this one; this one is tested against the
+reference's own run-log arithmetic (SURVEY.md Appendix A golden vectors).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..ops import chain
+from .base import PathSimBackend, register_backend
+
+
+@register_backend("numpy")
+class NumpyBackend(PathSimBackend):
+    def __init__(self, hin, metapath, dtype=np.float64, **options):
+        super().__init__(hin, metapath, **options)
+        self.dtype = dtype
+        if metapath.is_symmetric:
+            half = chain.oriented_dense_blocks(hin, metapath.half(), dtype=dtype)
+            self._c = chain.half_product(half, xp=np)
+            self._blocks = None
+        else:
+            self._c = None
+            self._blocks = chain.oriented_dense_blocks(hin, metapath.steps, dtype=dtype)
+        self._m: np.ndarray | None = None
+        self._rowsums: np.ndarray | None = None
+
+    def commuting_matrix(self) -> np.ndarray:
+        if self._m is None:
+            if self._c is not None:
+                self._m = chain.commuting_matrix_from_half(self._c, xp=np)
+            else:
+                self._m = chain.chain_product(self._blocks, xp=np)
+        return self._m
+
+    def global_walks(self) -> np.ndarray:
+        if self._rowsums is None:
+            if self._c is not None:
+                self._rowsums = chain.rowsums_from_half(self._c, xp=np)
+            else:
+                self._rowsums = chain.rowsums_general(self._blocks, xp=np)
+        return self._rowsums
+
+    def pairwise_row(self, source_index: int) -> np.ndarray:
+        if self._m is not None:
+            return self._m[source_index]
+        if self._c is not None:
+            return chain.pairwise_row_from_half(self._c, source_index, xp=np)
+        # general chain: fold source one-hot from the left
+        v = self._blocks[0][source_index]
+        for b in self._blocks[1:]:
+            v = v @ b
+        return v

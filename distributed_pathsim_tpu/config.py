@@ -1,0 +1,29 @@
+"""Run configuration.
+
+The reference hard-codes everything — dataset path, source author, output
+path, engine package pin (``DPathSim_APVPA.py:141-176``). This is the real
+config/flag system BASELINE.json asks for: dataset, backend, metapath,
+variant, sharding, dtype, output — constructible from the CLI or
+programmatically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class RunConfig:
+    dataset: str = "/root/reference/dblp/dblp_small.gexf"
+    backend: str = "jax"  # see backends.available_backends()
+    metapath: str = "APVPA"
+    variant: str = "rowsum"  # reference semantics; "diagonal" = Sun et al.
+    source: str | None = None  # node label (like the reference) …
+    source_id: str | None = None  # … or node id
+    output: str | None = None  # reference-grammar log path
+    metrics: str | None = None  # JSONL metrics path
+    all_pairs: bool = False
+    top_k: int = 0
+    n_devices: int | None = None  # sharded backends: devices to use
+    dtype: str = "float32"
+    echo: bool = True

@@ -1,0 +1,153 @@
+"""Typed-adjacency encoding: host strings → device-ready dense indices.
+
+The reference ships raw string tuple-lists into Spark DataFrames and lets
+Catalyst join on strings (``DPathSim_APVPA.py:160-163``). TPU-first design
+inverts this: every node type gets its own contiguous dense index space on
+the host, and each relationship becomes a COO block of ``(row, col)`` int32
+index pairs between two type spaces. Everything downstream (dense, sharded,
+sparse, pallas) consumes these blocks; strings never reach the device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from .schema import HINGraph, HINSchema, infer_schema
+
+
+@dataclasses.dataclass(frozen=True)
+class TypeIndex:
+    """Bidirectional id↔dense-index map for one node type.
+
+    Index order is vertex file order, which is the reference's iteration
+    (and log) order. ``size_override`` supports huge synthetic graphs
+    whose ids are implicit ranges (no per-node strings); such indices
+    still report the correct size but cannot resolve string ids.
+    """
+
+    node_type: str
+    ids: tuple[str, ...]
+    labels: tuple[str, ...]
+    index_of: dict[str, int]
+    size_override: int | None = None
+
+    @property
+    def size(self) -> int:
+        return self.size_override if self.size_override is not None else len(self.ids)
+
+    def label_of_index(self, i: int) -> str:
+        return self.labels[i]
+
+
+@dataclasses.dataclass(frozen=True)
+class AdjacencyBlock:
+    """COO adjacency block for one relationship: rows in ``src_type``'s
+    index space, cols in ``dst_type``'s. Entries are unique (simple graph —
+    see gexf.py dedup) and unweighted (weight 1, like the reference data).
+    """
+
+    relationship: str
+    src_type: str
+    dst_type: str
+    rows: np.ndarray  # int32 [nnz]
+    cols: np.ndarray  # int32 [nnz]
+    shape: tuple[int, int]
+
+    @property
+    def nnz(self) -> int:
+        return int(self.rows.shape[0])
+
+    def to_dense(self, dtype=np.float64) -> np.ndarray:
+        out = np.zeros(self.shape, dtype=dtype)
+        out[self.rows, self.cols] = 1
+        return out
+
+    def transpose(self) -> "AdjacencyBlock":
+        return AdjacencyBlock(
+            relationship=self.relationship + "^T",
+            src_type=self.dst_type,
+            dst_type=self.src_type,
+            rows=self.cols,
+            cols=self.rows,
+            shape=(self.shape[1], self.shape[0]),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class EncodedHIN:
+    """A fully encoded HIN: schema + per-type index spaces + COO blocks."""
+
+    schema: HINSchema
+    indices: dict[str, TypeIndex]
+    blocks: dict[str, AdjacencyBlock]  # keyed by relationship name
+    name: str = ""
+
+    def type_size(self, node_type: str) -> int:
+        return self.indices[node_type].size
+
+    def block(self, relationship: str) -> AdjacencyBlock:
+        return self.blocks[relationship]
+
+    def find_index_by_label(self, node_type: str, label: str) -> int | None:
+        """Label→dense index within a type (the reference's name→id lookup,
+        ``DPathSim_APVPA.py:132-137``, composed with index encoding)."""
+        idx = self.indices[node_type]
+        try:
+            return idx.labels.index(label)
+        except ValueError:
+            return None
+
+
+def encode_hin(graph: HINGraph, schema: HINSchema | None = None) -> EncodedHIN:
+    """Encode a host graph into typed index spaces and COO blocks.
+
+    Edges whose endpoints are missing from the vertex table are rejected;
+    edges whose relationship has no schema entry are rejected. Isolated
+    nodes (e.g. dblp_small's 10 ``topic`` nodes) still get index entries —
+    they simply appear in no block.
+    """
+    if schema is None:
+        schema = infer_schema(graph)
+
+    per_type: dict[str, list[tuple[str, str]]] = {t: [] for t in schema.node_types}
+    for v in graph.vertices:
+        per_type.setdefault(v.node_type, []).append((v.id, v.label))
+
+    indices: dict[str, TypeIndex] = {}
+    for node_type, pairs in per_type.items():
+        ids = tuple(p[0] for p in pairs)
+        labels = tuple(p[1] for p in pairs)
+        indices[node_type] = TypeIndex(
+            node_type=node_type,
+            ids=ids,
+            labels=labels,
+            index_of={i: k for k, i in enumerate(ids)},
+        )
+
+    per_rel: dict[str, tuple[list[int], list[int]]] = {
+        r: ([], []) for r in schema.relations
+    }
+    for e in graph.edges:
+        sig = schema.relations.get(e.relationship)
+        if sig is None:
+            raise ValueError(f"edge relationship {e.relationship!r} not in schema")
+        src_type, dst_type = sig
+        rows, cols = per_rel[e.relationship]
+        rows.append(indices[src_type].index_of[e.src])
+        cols.append(indices[dst_type].index_of[e.dst])
+
+    blocks: dict[str, AdjacencyBlock] = {}
+    for rel, (rows, cols) in per_rel.items():
+        src_type, dst_type = schema.relations[rel]
+        blocks[rel] = AdjacencyBlock(
+            relationship=rel,
+            src_type=src_type,
+            dst_type=dst_type,
+            rows=np.asarray(rows, dtype=np.int32),
+            cols=np.asarray(cols, dtype=np.int32),
+            shape=(indices[src_type].size, indices[dst_type].size),
+        )
+
+    return EncodedHIN(schema=schema, indices=indices, blocks=blocks, name=graph.name)

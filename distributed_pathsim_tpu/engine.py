@@ -1,0 +1,46 @@
+"""Engine bootstrap: dataset → encoded HIN → compiled metapath → backend.
+
+The analog of the reference's Spark bootstrap block
+(``DPathSim_APVPA.py:146-168``) — except "starting the engine" here means
+compiling a jit program, and the ``backend=`` flag (BASELINE.json) picks
+the execution strategy instead of a pinned JVM package.
+"""
+
+from __future__ import annotations
+
+from .backends.base import PathSimBackend, create_backend
+from .config import RunConfig
+from .data.encode import EncodedHIN, encode_hin
+from .data.gexf import read_gexf
+from .driver import PathSimDriver
+from .ops.metapath import MetaPath, compile_metapath
+
+
+def load_dataset(path: str) -> EncodedHIN:
+    graph = read_gexf(path)
+    return encode_hin(graph)
+
+
+def build(config: RunConfig) -> tuple[EncodedHIN, MetaPath, PathSimBackend, PathSimDriver]:
+    hin = load_dataset(config.dataset)
+    metapath = compile_metapath(config.metapath, hin.schema)
+    options = {}
+    if config.n_devices is not None:
+        options["n_devices"] = config.n_devices
+    if config.dtype:
+        options["dtype"] = _resolve_dtype(config.backend, config.dtype)
+    backend = create_backend(config.backend, hin, metapath, **options)
+    driver = PathSimDriver(backend, variant=config.variant)
+    return hin, metapath, backend, driver
+
+
+def _resolve_dtype(backend: str, dtype: str):
+    """Map the config's dtype string to the backend's array library.
+    float64 on JAX backends requires x64 mode (jax.config.jax_enable_x64)."""
+    if backend == "numpy":
+        import numpy as np
+
+        return np.dtype(dtype)
+    import jax.numpy as jnp
+
+    return jnp.dtype(dtype)

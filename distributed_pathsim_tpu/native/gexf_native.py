@@ -1,0 +1,16 @@
+"""ctypes bridge to the C++ GEXF parser (built lazily from native/).
+
+Falls back cleanly when the shared library can't be built; see
+native/gexf_fast.cpp. For now this is a stub that reports unavailable —
+the build hook lands with the native milestone.
+"""
+
+from __future__ import annotations
+
+
+def available() -> bool:
+    return False
+
+
+def read_gexf(path: str):
+    raise NotImplementedError("native GEXF parser not built")

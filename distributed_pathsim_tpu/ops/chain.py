@@ -1,0 +1,86 @@
+"""Commuting-matrix chain evaluation.
+
+The reference computes one entry (or one row sum) of the commuting matrix
+per distributed 4-way join, ``2N-1`` joins per run (``DPathSim_APVPA.py:
+28-68``). Here the chain is evaluated as staged matmuls — O(1) GEMMs for
+the whole all-pairs problem. Functions are array-library agnostic: pass
+``numpy`` for the f64 oracle or ``jax.numpy`` inside jit for TPU.
+
+Key identities used throughout (SURVEY.md §3.3, verified against the
+reference's own run log):
+
+- symmetric path:  M = C @ Cᵀ,  C = product of the first half
+- row sums without M:  rowsum(M) = C @ (Σ_x C[x, :])   (symmetric)
+                       rowsum(M) = B₁ @ (B₂ @ … (Bₖ @ 1))  (general)
+- pairwise row:  M[s, :] = (C[s, :] @ Cᵀ)  — one GEMV
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import numpy as np
+
+from ..data.encode import EncodedHIN
+from .metapath import MetaPath, Step
+
+
+def oriented_dense_blocks(
+    hin: EncodedHIN,
+    steps: Sequence[Step],
+    dtype: Any = np.float64,
+) -> list[np.ndarray]:
+    """Materialize the oriented dense adjacency block for each step
+    (host-side numpy; backends move/convert as needed)."""
+    out = []
+    for st in steps:
+        b = hin.block(st.relationship)
+        dense = b.to_dense(dtype=dtype)
+        out.append(dense.T if st.reverse else dense)
+    return out
+
+
+def chain_product(blocks: Sequence[Any], xp: Any = np):
+    """Left-to-right product of the oriented chain.
+
+    Left-to-right is optimal for metapaths that start from a large node
+    set and contract through small ones (A×P · P×V → A×V stays small);
+    callers with pathological shapes can pre-associate.
+    """
+    m = blocks[0]
+    for b in blocks[1:]:
+        m = xp.matmul(m, b)
+    return m
+
+
+def half_product(hin_blocks: Sequence[Any], xp: Any = np):
+    """C for a symmetric chain: product of the first-half oriented blocks."""
+    return chain_product(hin_blocks, xp=xp)
+
+
+def commuting_matrix_from_half(c, xp: Any = np):
+    """M = C @ Cᵀ (symmetric by construction)."""
+    return xp.matmul(c, c.T if hasattr(c, "T") else xp.transpose(c))
+
+
+def rowsums_from_half(c, xp: Any = np):
+    """rowsum(M) = C @ (Σ_x C[x, :]) — the reference's "global walk" for
+    every node at once, without materializing M. O(N·V) instead of O(N²)."""
+    total = xp.sum(c, axis=0)
+    return xp.matmul(c, total)
+
+
+def rowsums_general(blocks: Sequence[Any], xp: Any = np):
+    """rowsum(M) for an arbitrary oriented chain: fold the all-ones vector
+    from the right — never materializes anything wider than a block."""
+    last = blocks[-1]
+    v = xp.ones((last.shape[-1],), dtype=last.dtype)
+    for b in reversed(blocks):
+        v = xp.matmul(b, v)
+    return v
+
+
+def pairwise_row_from_half(c, source_index: int, xp: Any = np):
+    """M[source, :] = C[source] @ Cᵀ — one GEMV, the batched analog of the
+    reference's per-pair motif query."""
+    return xp.matmul(c, c[source_index])

@@ -1,0 +1,48 @@
+"""PathSim score normalization.
+
+The reference's score (``DPathSim_APVPA.py:51-52``) is the *row-sum
+variant*: sim(x,y) = 2·M[x,y] / (Σ_z M[x,z] + Σ_z M[y,z]), because its
+"global walk" motif leaves ``author_2`` unconstrained (SURVEY.md §3.3 —
+verified to the last digit against the reference's run log). The textbook
+PathSim of Sun et al. normalizes by the diagonal instead:
+sim(x,y) = 2·M[x,y] / (M[x,x] + M[y,y]). Both variants are provided;
+``variant="rowsum"`` is the default and the parity target.
+
+Degenerate denominators: with integer path counts, denom == 0 implies
+M[x,y] == 0 (the numerator is bounded by either row sum); the reference
+would raise ZeroDivisionError there (plain Python division). We define
+the score as 0.0 in that case — the only semantic divergence, and only
+on inputs that crash the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+VARIANTS = ("rowsum", "diagonal")
+
+
+def _denominators(m, rowsums, variant: str, xp: Any):
+    if variant == "rowsum":
+        if rowsums is None:
+            rowsums = xp.sum(m, axis=1)
+        return rowsums
+    if variant == "diagonal":
+        return xp.diagonal(m)
+    raise ValueError(f"unknown PathSim variant {variant!r}; choose {VARIANTS}")
+
+
+def score_matrix(m, rowsums=None, variant: str = "rowsum", xp: Any = np):
+    """All-pairs scores: sim = 2·M / (d[:, None] + d[None, :])."""
+    d = _denominators(m, rowsums, variant, xp)
+    denom = d[:, None] + d[None, :]
+    return xp.where(denom > 0, 2.0 * m / xp.where(denom > 0, denom, 1), 0.0)
+
+
+def score_row(m_row, d_source, d, xp: Any = np):
+    """Scores from one source against all targets, given its pairwise row
+    ``m_row = M[s, :]`` and the denominator vector ``d``."""
+    denom = d_source + d
+    return xp.where(denom > 0, 2.0 * m_row / xp.where(denom > 0, denom, 1), 0.0)

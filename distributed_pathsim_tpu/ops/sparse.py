@@ -1,0 +1,234 @@
+"""Sparse half-chain machinery: host COO folding + device scatter/GEMM.
+
+TPU-first split of labor (BASELINE.json config 5): the *structure* of the
+half-chain product — which (source, venue)-style pairs exist — is a
+sort/searchsorted join on the host (O(nnz log nnz), numpy); the *numbers*
+— duplicate accumulation, row sums, all-pairs tiles — run on device as
+scatter-adds and dense GEMMs over static-shaped tiles. This replaces the
+reference's per-query 4-way distributed hash join with one precomputed
+join reused by every query, and it never builds a P×V or N×N dense
+intermediate.
+
+Why not jax.experimental.sparse BCOO end-to-end: BCOO sparse-sparse
+products on TPU lower to gather/scatter loops XLA can't tile onto the
+MXU; folding structure on host and batching the arithmetic into dense
+tiles keeps the FLOPs where the hardware wants them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class COOMatrix:
+    """Weighted COO with possibly-duplicate coordinates ("unsummed")."""
+
+    rows: np.ndarray  # int [nnz]
+    cols: np.ndarray  # int [nnz]
+    weights: np.ndarray  # float64 [nnz]
+    shape: tuple[int, int]
+
+    def summed(self) -> "COOMatrix":
+        """Coalesce duplicates (host)."""
+        key = self.rows.astype(np.int64) * self.shape[1] + self.cols
+        uniq, inv = np.unique(key, return_inverse=True)
+        w = np.zeros(uniq.shape[0], dtype=np.float64)
+        np.add.at(w, inv, self.weights)
+        return COOMatrix(
+            rows=(uniq // self.shape[1]).astype(np.int64),
+            cols=(uniq % self.shape[1]).astype(np.int64),
+            weights=w,
+            shape=self.shape,
+        )
+
+
+def coo_from_block(block) -> COOMatrix:
+    return COOMatrix(
+        rows=block.rows.astype(np.int64),
+        cols=block.cols.astype(np.int64),
+        weights=np.ones(block.rows.shape[0], dtype=np.float64),
+        shape=block.shape,
+    )
+
+
+def coo_matmul(a: COOMatrix, b: COOMatrix) -> COOMatrix:
+    """Host COO·COO join on the shared middle index.
+
+    Sort b by row, locate each a-edge's matching slice via searchsorted,
+    expand pairs, multiply weights. Output is unsummed (duplicates carry
+    partial products) — coalesce with .summed() when needed.
+    """
+    if a.shape[1] != b.shape[0]:
+        raise ValueError(f"shape mismatch {a.shape} @ {b.shape}")
+    order = np.argsort(b.rows, kind="stable")
+    b_rows = b.rows[order]
+    b_cols = b.cols[order]
+    b_w = b.weights[order]
+
+    start = np.searchsorted(b_rows, a.cols, side="left")
+    stop = np.searchsorted(b_rows, a.cols, side="right")
+    counts = stop - start
+    total = int(counts.sum())
+
+    # For each a-edge i, take b entries [start[i], stop[i]).
+    a_idx = np.repeat(np.arange(a.rows.shape[0]), counts)
+    # offsets within each slice: ramp resetting at slice boundaries
+    cum = np.concatenate([[0], np.cumsum(counts)])
+    within = np.arange(total) - np.repeat(cum[:-1], counts)
+    b_idx = np.repeat(start, counts) + within
+
+    return COOMatrix(
+        rows=a.rows[a_idx],
+        cols=b_cols[b_idx],
+        weights=a.weights[a_idx] * b_w[b_idx],
+        shape=(a.shape[0], b.shape[1]),
+    )
+
+
+def fold_half_chain(blocks) -> COOMatrix:
+    """Fold oriented COO blocks left-to-right into the half-chain factor C
+    (coalesced)."""
+    acc = blocks[0]
+    for b in blocks[1:]:
+        acc = coo_matmul(acc, b).summed()
+    return acc
+
+
+# ---------------------------------------------------------------------------
+# Device side: static-shaped scatter + tile GEMMs
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("n_rows", "n_cols"))
+def densify_tile(rows, cols, weights, n_rows: int, n_cols: int):
+    """Scatter a (padded) COO slice into a dense [n_rows, n_cols] tile.
+    Padding entries must carry weight 0 (they scatter harmlessly)."""
+    out = jnp.zeros((n_rows, n_cols), dtype=weights.dtype)
+    return out.at[rows, cols].add(weights)
+
+
+@jax.jit
+def tile_outer(c_tile_i, c_tile_j):
+    """One [Ti, Tj] tile of M = C Cᵀ."""
+    with jax.default_matmul_precision("highest"):
+        return jnp.matmul(c_tile_i, c_tile_j.T)
+
+
+@jax.jit
+def tile_rowsums(c_tile, colsum_total):
+    with jax.default_matmul_precision("highest"):
+        return jnp.matmul(c_tile, colsum_total)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def tile_topk(scores_tile, k: int):
+    """Per-row top-k of a scores tile: values and column indices."""
+    return jax.lax.top_k(scores_tile, k)
+
+
+class TiledHalfChain:
+    """Row-tiled dense view of a sparse half-chain factor C [N, V].
+
+    Host keeps C as CSR-sorted COO; tiles of ``tile_rows`` rows are
+    densified on device on demand. V (the contracted output width, e.g.
+    #venues) is assumed tileable as one dense axis — it is orders of
+    magnitude smaller than N in every target config.
+    """
+
+    def __init__(
+        self,
+        c: COOMatrix,
+        tile_rows: int = 4096,
+        dtype=jnp.float32,
+        max_cached_tiles: int | None = None,
+    ):
+        self.n, self.v = c.shape
+        self.tile_rows = int(tile_rows)
+        self.dtype = dtype
+        order = np.argsort(c.rows, kind="stable")
+        self._rows = c.rows[order]
+        self._cols = c.cols[order]
+        self._weights = c.weights[order]
+        self.n_tiles = (self.n + self.tile_rows - 1) // self.tile_rows
+        # per-tile COO extents
+        bounds = np.arange(self.n_tiles + 1) * self.tile_rows
+        self._tile_start = np.searchsorted(self._rows, bounds[:-1], side="left")
+        self._tile_stop = np.searchsorted(self._rows, bounds[1:], side="left")
+        self._max_nnz = (
+            int((self._tile_stop - self._tile_start).max()) if self.n_tiles else 0
+        )
+        # Bounded LRU of densified tiles: default keeps ≤256 MB of C tiles
+        # on device, so streaming passes over huge N don't accumulate the
+        # whole dense C (which would defeat the tiled design).
+        if max_cached_tiles is None:
+            tile_bytes = self.tile_rows * self.v * np.dtype(dtype).itemsize
+            max_cached_tiles = max(2, (256 << 20) // max(tile_bytes, 1))
+        self._max_cached = int(max_cached_tiles)
+        self._cache: dict[int, jax.Array] = {}  # insertion-ordered → LRU
+        # Exact global column totals, accumulated in f64 on host: rowsums
+        # are C @ colsum_total and must stay integer-exact.
+        colsum = np.zeros(self.v, dtype=np.float64)
+        np.add.at(colsum, self._cols, self._weights)
+        self.colsum_total = colsum
+        # f32 carries exact integers only to 2^24; a silently truncated
+        # count would corrupt every downstream score, so refuse loudly.
+        if np.dtype(dtype) == np.float32:
+            max_rowsum = float(colsum.sum())  # upper bound on any row sum
+            if max_rowsum >= 2**24:
+                self._check_exact_rowsums()
+
+    def _check_exact_rowsums(self) -> None:
+        """Tight per-row check, only run when the cheap bound trips."""
+        rs = np.zeros(self.n, dtype=np.float64)
+        np.add.at(rs, self._rows, self._weights * self.colsum_total[self._cols])
+        if rs.max(initial=0.0) >= 2**24:
+            raise OverflowError(
+                "path counts exceed f32 exact-integer range (2^24); "
+                "construct TiledHalfChain with dtype=jnp.float64 "
+                "(requires JAX_ENABLE_X64)"
+            )
+
+    def tile(self, i: int) -> jax.Array:
+        """Dense [tile_rows, V] tile i of C (padded rows are zero)."""
+        if i in self._cache:
+            self._cache[i] = self._cache.pop(i)  # refresh LRU position
+            return self._cache[i]
+        s, e = int(self._tile_start[i]), int(self._tile_stop[i])
+        nnz = e - s
+        # Pad every tile's COO slice to the same max nnz so one compiled
+        # scatter program serves all tiles (static shapes for XLA).
+        rows = np.zeros(self._max_nnz, dtype=np.int32)
+        cols = np.zeros(self._max_nnz, dtype=np.int32)
+        w = np.zeros(self._max_nnz, dtype=np.float64)
+        rows[:nnz] = self._rows[s:e] - i * self.tile_rows
+        cols[:nnz] = self._cols[s:e]
+        w[:nnz] = self._weights[s:e]
+        t = densify_tile(
+            jnp.asarray(rows),
+            jnp.asarray(cols),
+            jnp.asarray(w, dtype=self.dtype),
+            n_rows=self.tile_rows,
+            n_cols=self.v,
+        )
+        while len(self._cache) >= self._max_cached:
+            self._cache.pop(next(iter(self._cache)))  # evict LRU
+        self._cache[i] = t
+        return t
+
+    def rowsums(self) -> np.ndarray:
+        out = np.zeros(self.n_tiles * self.tile_rows, dtype=np.float64)
+        total = jnp.asarray(self.colsum_total, dtype=self.dtype)
+        for i in range(self.n_tiles):
+            out[i * self.tile_rows : (i + 1) * self.tile_rows] = np.asarray(
+                tile_rowsums(self.tile(i), total), dtype=np.float64
+            )
+        return out[: self.n]
+
+    def m_tile(self, i: int, j: int) -> jax.Array:
+        return tile_outer(self.tile(i), self.tile(j))

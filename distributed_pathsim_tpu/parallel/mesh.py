@@ -1,0 +1,53 @@
+"""Device-mesh construction.
+
+The reference's entire distribution story is Spark's opaque JVM-side
+partitioned join (SURVEY.md §2, parallelism inventory). Here distribution
+is explicit: a `jax.sharding.Mesh` whose axes name the parallelism —
+
+- ``"dp"``: the author/output-row axis of the commuting matrix (the analog
+  of Spark's data partitioning — 1-D tensor parallelism of the chain)
+- ``"tp"``: optional second axis for 2-D block tiling of all-pairs outputs
+  at the 1M-author scale (BASELINE.json config 5)
+
+Shardings ride ICI within a host slice and DCN across hosts — XLA inserts
+the collectives; nothing here talks to a transport.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+
+def make_mesh(
+    n_devices: int | None = None, axis: str = "dp", devices=None
+) -> Mesh:
+    """1-D mesh over the first ``n_devices`` available devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(
+                f"requested {n_devices} devices, only {len(devices)} available"
+            )
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (axis,))
+
+
+def make_mesh_2d(
+    shape: tuple[int, int],
+    axes: tuple[str, str] = ("dp", "tp"),
+    devices=None,
+) -> Mesh:
+    """2-D mesh for block-tiled all-pairs computation."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = shape[0] * shape[1]
+    if n > len(devices):
+        raise ValueError(f"mesh {shape} needs {n} devices, have {len(devices)}")
+    return Mesh(np.array(devices[:n]).reshape(shape), axes)
+
+
+def pad_to_multiple(n: int, k: int) -> int:
+    """Smallest multiple of k that is >= n."""
+    return ((n + k - 1) // k) * k

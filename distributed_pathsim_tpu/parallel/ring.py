@@ -1,0 +1,57 @@
+"""Ring all-pairs: ppermute-streamed block outer products.
+
+The ring-attention communication pattern (blockwise KV rotation over ICI)
+applied to this workload's scaling axis — the author dimension of the
+commuting matrix (SURVEY.md §5, long-context row). Each device holds one
+row-block of the half-chain factor ``C``; the peer block rotates around
+the ring while each device accumulates one ``C_local @ C_peerᵀ`` tile of
+its M row-block per step. Communication per step is ``N/d × V`` — all of
+``M`` (N×N) and all of ``C`` (N×V) never materialize on any one device,
+which is what makes the 1M-author configuration reachable.
+
+Compute/communication overlap: each step's matmul runs while XLA can
+schedule the next ppermute; on TPU the permute rides neighbor ICI links
+(the mesh axis order is the ring order).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def ring_allpairs_rowblock(c_local: jax.Array, axis: str) -> jax.Array:
+    """Inside shard_map: compute this device's row-block of M = C Cᵀ by
+    rotating peer blocks around the ``axis`` ring.
+
+    c_local: [n_loc, V] — this device's rows of C.
+    Returns [n_loc, n_dev * n_loc] — this device's rows of M (padded N).
+    """
+    n_dev = jax.lax.axis_size(axis)
+    my = jax.lax.axis_index(axis)
+    n_loc = c_local.shape[0]
+    perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+
+    def step(k, carry):
+        block, m = carry
+        # After k rotations device `my` holds the block originally owned
+        # by device (my - k) mod n_dev — its tile lands at that column.
+        owner = (my - k) % n_dev
+        tile = jnp.matmul(c_local, block.T)
+        col0 = (owner * n_loc).astype(jnp.int32)
+        m = jax.lax.dynamic_update_slice(m, tile, (jnp.int32(0), col0))
+        block = jax.lax.ppermute(block, axis, perm)
+        return block, m
+
+    # pcast: the accumulator is device-varying (each device builds different
+    # rows of M) — shard_map's varying-axis tracking needs that declared.
+    m0 = jax.lax.pcast(
+        jnp.zeros((n_loc, n_dev * n_loc), dtype=c_local.dtype),
+        (axis,),
+        to="varying",
+    )
+    # The final ppermute is wasted motion but keeps the loop uniform; XLA
+    # dead-code-eliminates the unused last rotation's result only if we
+    # drop it — we do.
+    _, m = jax.lax.fori_loop(0, n_dev, step, (c_local, m0))
+    return m

@@ -1,0 +1,100 @@
+"""Row-sharded commuting-matrix chain under shard_map.
+
+SPMD design (BASELINE.json config 3): the first block of the chain (the
+source-type × next-type adjacency, e.g. ``A_AP``) is sharded along its
+rows over the ``dp`` mesh axis; the remaining (small, contracted) blocks
+are replicated. Each device computes its row-block of the half-chain
+``C = A_AP @ A_PV`` locally; then
+
+- global column total  (Σ_x C[x, :]):  local colsum + ``psum`` over dp —
+  this is the ONLY cross-device reduction the row sums need
+- row sums:  ``C_local @ colsum_total``       (no communication)
+- all-pairs M row-block:  ``C_local @ C_fullᵀ`` where ``C_full`` comes
+  from ``all_gather`` (moderate N), or from a ``ppermute`` ring that
+  streams peer blocks through ICI without ever holding all of M or all
+  of C (large N — the ring-attention communication pattern applied to
+  the author axis; see parallel/ring.py)
+
+Padding: the row axis is padded to a device multiple with all-zero rows;
+zero rows of ``A_AP`` produce zero rows of C and M and contribute zero to
+every ``psum`` — tested, not assumed (tests/test_sharded.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import pad_to_multiple
+from .ring import ring_allpairs_rowblock
+
+
+def shard_first_block_rows(
+    first: np.ndarray, mesh: Mesh, axis: str = "dp"
+) -> jax.Array:
+    """Pad the row axis to a device multiple and place with rows sharded
+    over ``axis``. Returns the padded, sharded device array."""
+    n_dev = mesh.shape[axis]
+    n_pad = pad_to_multiple(first.shape[0], n_dev)
+    if n_pad != first.shape[0]:
+        first = np.pad(first, ((0, n_pad - first.shape[0]), (0, 0)))
+    sharding = NamedSharding(mesh, P(axis, None))
+    return jax.device_put(first, sharding)
+
+
+def replicate(x: np.ndarray, mesh: Mesh) -> jax.Array:
+    return jax.device_put(x, NamedSharding(mesh, P()))
+
+
+@functools.partial(
+    jax.jit, static_argnames=("mesh", "axis", "allpairs_strategy", "want_m")
+)
+def sharded_chain_outputs(
+    first: jax.Array,
+    rest: Sequence[jax.Array],
+    mesh: Mesh,
+    axis: str = "dp",
+    allpairs_strategy: str = "allgather",
+    want_m: bool = True,
+):
+    """Compute (M_rowblocks, rowsums) for a *symmetric* chain, sharded.
+
+    ``first`` is the row-sharded (padded) first half-block; ``rest`` are
+    the remaining replicated half-chain blocks. Returns M with rows
+    sharded over ``axis`` (or None if ``want_m`` is False) and the full
+    rowsum vector, row-sharded.
+    """
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(axis, None), tuple(P() for _ in rest)),
+        out_specs=(P(axis, None) if want_m else P(), P(axis)),
+    )
+    def run(first_local, rest_blocks):
+        with jax.default_matmul_precision("highest"):
+            c_local = first_local
+            for b in rest_blocks:
+                c_local = jnp.matmul(c_local, b)
+            colsum_total = jax.lax.psum(jnp.sum(c_local, axis=0), axis)
+            rowsums_local = jnp.matmul(c_local, colsum_total)
+            if not want_m:
+                return jnp.zeros((1, 1), dtype=c_local.dtype), rowsums_local
+            if allpairs_strategy == "allgather":
+                c_full = jax.lax.all_gather(c_local, axis, axis=0, tiled=True)
+                m_local = jnp.matmul(c_local, c_full.T)
+            elif allpairs_strategy == "ring":
+                m_local = ring_allpairs_rowblock(c_local, axis)
+            else:
+                raise ValueError(
+                    f"unknown allpairs_strategy {allpairs_strategy!r}"
+                )
+            return m_local, rowsums_local
+
+    m, rowsums = run(first, tuple(rest))
+    return (m if want_m else None), rowsums

@@ -1,0 +1,105 @@
+"""Run logging: the reference's exact log grammar + structured metrics.
+
+The reference mirrors the walk/score lines to stdout AND an append-mode
+UTF-8 file, but writes the ``***Stage/Overall done`` markers and ``---``
+separators to the file only, flushing per stage
+(``DPathSim_APVPA.py:24-68`` — ``print`` at :32,:42,:47,:56; file-only
+writes at :63-64,:67). We reproduce both channels exactly. File grammar
+per stage (see reference ``output/d_pathsim_output_20180417_020445.log:1-6``):
+
+    Source author global walk: <int>
+    Pairwise authors walk <target_id>: <int>
+    Target author global walk: <int>
+    Sim score <source_label> - <target_label>: <float>
+    ***Stage done in: <seconds>
+    ---
+    ...
+    ***Overall done in: <seconds>
+
+Float rendering is Python ``str(float)``, same wording — file output is
+byte-diffable against the reference log. A JSONL metrics channel is added
+as a new capability.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from typing import IO, Any
+
+
+class RunLogger:
+    """Dual-channel logger: reference-grammar text + optional JSONL."""
+
+    def __init__(
+        self,
+        output_path: str | None = None,
+        echo: bool = True,
+        metrics_path: str | None = None,
+    ):
+        self._file: IO[str] | None = (
+            open(output_path, "a", encoding="utf-8") if output_path else None
+        )
+        self._echo = echo
+        self._metrics: IO[str] | None = (
+            open(metrics_path, "a", encoding="utf-8") if metrics_path else None
+        )
+        self.overall_start = time.perf_counter()
+
+    # -- reference grammar -------------------------------------------------
+
+    def source_global_walk(self, count: int) -> None:
+        self._line(f"Source author global walk: {count}")
+
+    def pairwise_walk(self, target_id: str, count: int) -> None:
+        self._line(f"Pairwise authors walk {target_id}: {count}")
+
+    def target_global_walk(self, count: int) -> None:
+        self._line(f"Target author global walk: {count}")
+
+    def sim_score(self, source_label: str, target_label: str, score: float) -> None:
+        self._line(f"Sim score {source_label} - {target_label}: {score}")
+
+    def stage_done(self, seconds: float) -> None:
+        self._write(f"***Stage done in: {seconds}\n")
+        self._write("---\n")
+        self.flush()
+
+    def overall_done(self) -> None:
+        self._write(
+            f"***Overall done in: {time.perf_counter() - self.overall_start}\n"
+        )
+        self.close()
+
+    # -- structured channel (new capability) -------------------------------
+
+    def metric(self, **fields: Any) -> None:
+        if self._metrics is not None:
+            fields.setdefault("ts", time.time())
+            self._metrics.write(json.dumps(fields) + "\n")
+            self._metrics.flush()
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _line(self, text: str) -> None:
+        if self._echo:
+            print(text)
+        self._write(text + "\n")
+
+    def _write(self, text: str) -> None:
+        if self._file is not None:
+            self._file.write(text)
+
+    def flush(self) -> None:
+        if self._file is not None:
+            self._file.flush()
+        sys.stdout.flush()
+
+    def close(self) -> None:
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+        if self._metrics is not None:
+            self._metrics.close()
+            self._metrics = None

@@ -1,0 +1,55 @@
+"""Test configuration.
+
+All tests run on CPU with 8 virtual XLA devices so the real pjit/shard_map
+sharded paths (the multi-chip code) are exercised without TPU hardware —
+the standard JAX trick (SURVEY.md §4, item 4). These env vars must be set
+before jax initializes its backends, hence module scope, before any import
+of the package under test.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+# The axon TPU plugin's sitecustomize force-sets jax_platforms at
+# interpreter startup, overriding the env var — undo it before any backend
+# initializes so tests always run on the 8 virtual CPU devices.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import pathlib
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+REFERENCE = pathlib.Path("/root/reference")
+
+
+@pytest.fixture(scope="session")
+def dblp_small_path():
+    p = REFERENCE / "dblp" / "dblp_small.gexf"
+    if not p.exists():
+        pytest.skip("dblp_small.gexf not available")
+    return str(p)
+
+
+@pytest.fixture(scope="session")
+def dblp_small(dblp_small_path):
+    from distributed_pathsim_tpu.data.gexf import read_gexf
+
+    return read_gexf(dblp_small_path)
+
+
+@pytest.fixture(scope="session")
+def dblp_small_hin(dblp_small):
+    from distributed_pathsim_tpu.data.encode import encode_hin
+
+    return encode_hin(dblp_small)

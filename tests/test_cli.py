@@ -1,0 +1,71 @@
+"""CLI smoke tests (in-process)."""
+
+import pytest
+
+from distributed_pathsim_tpu.cli import main
+
+
+def test_single_source_run(dblp_small_path, tmp_path, capsys):
+    out = tmp_path / "out.log"
+    rc = main([
+        "--dataset", dblp_small_path,
+        "--backend", "numpy",
+        "--source", "Didier Dubois",
+        "--output", str(out),
+        "--top-k", "3",
+        "--quiet",
+    ])
+    assert rc == 0
+    text = out.read_text()
+    assert text.startswith("Source author global walk: 3\n")
+    captured = capsys.readouterr().out
+    assert "Salem Benferhat" in captured  # top-k print
+
+
+def test_all_pairs(dblp_small_path, capsys):
+    rc = main([
+        "--dataset", dblp_small_path,
+        "--backend", "numpy",
+        "--all-pairs",
+        "--quiet",
+    ])
+    assert rc == 0
+    assert "All-pairs scores: 770x770" in capsys.readouterr().out
+
+
+def test_nothing_to_do(dblp_small_path):
+    rc = main(["--dataset", dblp_small_path, "--quiet"])
+    assert rc == 2
+
+
+def test_source_id_flag(dblp_small_path, tmp_path):
+    out = tmp_path / "out.log"
+    rc = main([
+        "--dataset", dblp_small_path,
+        "--backend", "numpy",
+        "--source-id", "author_395340",
+        "--output", str(out),
+        "--quiet",
+    ])
+    assert rc == 0
+    assert "Didier Dubois" in out.read_text()
+
+
+def test_clean_error_for_unknown_source(dblp_small_path, capsys):
+    rc = main([
+        "--dataset", dblp_small_path, "--backend", "numpy",
+        "--source", "Jiawei Han", "--quiet",
+    ])
+    assert rc == 1
+    assert "no author labeled" in capsys.readouterr().err
+
+
+def test_dtype_flag_plumbs_through(dblp_small_path, tmp_path):
+    out = tmp_path / "o.log"
+    rc = main([
+        "--dataset", dblp_small_path, "--backend", "jax",
+        "--dtype", "float64",
+        "--source", "Didier Dubois", "--output", str(out), "--quiet",
+    ])
+    assert rc == 0
+    assert "Source author global walk: 3" in out.read_text()

@@ -1,0 +1,91 @@
+"""Driver + reference log grammar tests.
+
+The log must be diffable against the reference's format
+(output/d_pathsim_output_20180417_020445.log grammar, SURVEY.md §5).
+"""
+
+import re
+
+import numpy as np
+import pytest
+
+from distributed_pathsim_tpu.backends.base import create_backend
+from distributed_pathsim_tpu.driver import PathSimDriver
+from distributed_pathsim_tpu.ops.metapath import compile_metapath
+from distributed_pathsim_tpu.utils.logging import RunLogger
+
+
+@pytest.fixture(scope="module")
+def driver(dblp_small_hin):
+    mp = compile_metapath("APVPA", dblp_small_hin.schema)
+    return PathSimDriver(create_backend("numpy", dblp_small_hin, mp))
+
+
+def test_single_source_run(driver, tmp_path):
+    log_path = tmp_path / "run.log"
+    logger = RunLogger(output_path=str(log_path), echo=False)
+    res = driver.run_single_source("Didier Dubois", logger=logger)
+
+    assert res.source_id == "author_395340"
+    assert len(res.scores) == 769  # all authors but the source
+    # golden scores (SURVEY.md Appendix A)
+    assert res.scores[_id_of(driver, "Salem Benferhat")] == pytest.approx(1 / 3)
+    assert res.scores[_id_of(driver, "Henri Prade")] == pytest.approx(1 / 7)
+    assert sum(res.scores.values()) == pytest.approx(10 / 21)
+    # global walk integers
+    assert res.global_walks[_id_of(driver, "Henri Prade")] == 11
+
+    text = log_path.read_text(encoding="utf-8")
+    lines = text.splitlines()
+    assert lines[0] == "Source author global walk: 3"
+    # grammar: each stage is exactly 5 lines
+    stage = lines[1:6]
+    assert re.fullmatch(r"Pairwise authors walk author_\d+: \d+", stage[0])
+    assert re.fullmatch(r"Target author global walk: \d+", stage[1])
+    assert re.fullmatch(r"Sim score Didier Dubois - .+: [\d.e-]+", stage[2])
+    assert re.fullmatch(r"\*\*\*Stage done in: [\d.e-]+", stage[3])
+    assert stage[4] == "---"
+    assert lines[-1].startswith("***Overall done in: ")
+    # 769 stages of 5 lines + source line + overall line
+    assert len(lines) == 1 + 769 * 5 + 1
+
+
+def test_float_format_matches_reference_repr(driver, tmp_path):
+    """The reference writes scores with Python str(float) — ours must be
+    byte-identical for the same value."""
+    log_path = tmp_path / "fmt.log"
+    logger = RunLogger(output_path=str(log_path), echo=False)
+    driver.run_single_source("Didier Dubois", logger=logger)
+    text = log_path.read_text(encoding="utf-8")
+    assert f"Sim score Didier Dubois - Salem Benferhat: {1/3}" in text
+    assert f"Sim score Didier Dubois - Henri Prade: {1/7}" in text
+
+
+def test_unknown_source_raises(driver):
+    with pytest.raises(KeyError, match="Jiawei Han"):
+        driver.run_single_source("Jiawei Han")  # not present in dblp_small
+
+
+def test_top_k(driver):
+    top = driver.top_k("Didier Dubois", k=3)
+    labels = [t[1] for t in top]
+    assert labels[0] == "Salem Benferhat"  # 1/3, the highest non-self score
+    assert top[0][2] == pytest.approx(1 / 3)
+
+
+def test_metrics_channel(driver, tmp_path):
+    import json
+
+    mpath = tmp_path / "metrics.jsonl"
+    logger = RunLogger(
+        output_path=None, echo=False, metrics_path=str(mpath)
+    )
+    driver.run_single_source("Didier Dubois", logger=logger)
+    rec = json.loads(mpath.read_text().splitlines()[0])
+    assert rec["event"] == "source_global_walk"
+    assert rec["count"] == 3
+
+
+def _id_of(driver, label):
+    i = driver.hin.find_index_by_label("author", label)
+    return driver.index.ids[i]

@@ -1,0 +1,88 @@
+"""GEXF loader tests — counts from SURVEY.md §2 (C9), measured ground truth."""
+
+import numpy as np
+
+
+def test_counts(dblp_small):
+    assert len(dblp_small.vertices) == 1866
+    assert len(dblp_small.edges) == 2266
+    assert dblp_small.counts() == {
+        "author": 770,
+        "paper": 1001,
+        "venue": 85,
+        "topic": 10,
+    }
+
+
+def test_edge_relationships(dblp_small):
+    rels = {}
+    for e in dblp_small.edges:
+        rels[e.relationship] = rels.get(e.relationship, 0) + 1
+    assert rels == {"author_of": 1265, "submit_at": 1001}
+
+
+def test_find_by_label(dblp_small):
+    # Didier Dubois is the first author in file order (SURVEY.md Appendix A).
+    assert dblp_small.find_node_id_by_label("Didier Dubois") == "author_395340"
+    assert dblp_small.find_node_id_by_label("Jiawei Han") is None  # not in small
+
+
+def test_schema_inference(dblp_small):
+    from distributed_pathsim_tpu.data.schema import infer_schema
+
+    schema = infer_schema(dblp_small)
+    assert schema.relations == {
+        "author_of": ("author", "paper"),
+        "submit_at": ("paper", "venue"),
+    }
+    # topic nodes are isolated but still typed
+    assert "topic" in schema.node_types
+
+
+def test_encoding_roundtrip(dblp_small, dblp_small_hin):
+    hin = dblp_small_hin
+    assert hin.type_size("author") == 770
+    assert hin.type_size("paper") == 1001
+    assert hin.type_size("venue") == 85
+    ap = hin.block("author_of")
+    pv = hin.block("submit_at")
+    assert ap.shape == (770, 1001) and ap.nnz == 1265
+    assert pv.shape == (1001, 85) and pv.nnz == 1001
+    # id↔index round trip
+    idx = hin.indices["author"]
+    for i in (0, 100, 769):
+        assert idx.index_of[idx.ids[i]] == i
+    assert hin.find_index_by_label("author", "Didier Dubois") == 0
+
+
+def test_vertex_tuple_view_matches_reference_shape(dblp_small):
+    tup = dblp_small.vertex_tuples()[0]
+    assert len(tup) == 3  # (id, label, node_type)
+    et = dblp_small.edge_tuples()[0]
+    assert len(et) == 3  # (src, dst, relationship)
+
+
+def test_synthetic_roundtrip(tmp_path):
+    from distributed_pathsim_tpu.data.gexf import read_gexf
+    from distributed_pathsim_tpu.data.encode import encode_hin
+    from distributed_pathsim_tpu.data.synthetic import synthetic_hin, write_gexf
+
+    hin = synthetic_hin(50, 80, 7, n_topics=3, seed=1, materialize_ids=True)
+    p = tmp_path / "syn.gexf"
+    write_gexf(hin, str(p))
+    g2 = read_gexf(str(p), use_native=False)
+    hin2 = encode_hin(g2)
+    for rel in hin.blocks:
+        b1, b2 = hin.block(rel), hin2.block(rel)
+        d1 = b1.to_dense()
+        d2 = b2.to_dense()
+        assert b1.shape == b2.shape
+        np.testing.assert_array_equal(d1, d2)
+
+
+def test_lazy_synthetic_reports_size():
+    from distributed_pathsim_tpu.data.synthetic import synthetic_hin
+
+    hin = synthetic_hin(1000, 1400, 30, seed=3)  # materialize_ids=False
+    assert hin.type_size("author") == 1000
+    assert hin.type_size("paper") == 1400

@@ -1,0 +1,41 @@
+"""jit'd dense backend vs the f64 oracle (BASELINE gate: ≤1e-5 relative)."""
+
+import numpy as np
+import pytest
+
+from distributed_pathsim_tpu.backends.base import available_backends, create_backend
+from distributed_pathsim_tpu.ops.metapath import compile_metapath
+
+
+@pytest.fixture(scope="module")
+def pair(dblp_small_hin):
+    mp = compile_metapath("APVPA", dblp_small_hin.schema)
+    oracle = create_backend("numpy", dblp_small_hin, mp)
+    jx = create_backend("jax", dblp_small_hin, mp)
+    return oracle, jx
+
+
+def test_registry():
+    assert {"numpy", "jax", "jax-sharded", "jax-sparse"} <= set(available_backends())
+
+
+def test_matrix_exact(pair):
+    oracle, jx = pair
+    # counts are small integers: f32 matmul must be EXACT here
+    np.testing.assert_array_equal(jx.commuting_matrix(), oracle.commuting_matrix())
+    np.testing.assert_array_equal(jx.global_walks(), oracle.global_walks())
+
+
+def test_scores_within_gate(pair):
+    oracle, jx = pair
+    a, b = oracle.all_pairs_scores(), jx.all_pairs_scores()
+    denom = np.maximum(np.abs(a), 1e-12)
+    assert np.max(np.abs(a - b) / denom) <= 1e-5
+
+
+def test_single_source_scores(pair, dblp_small_hin):
+    oracle, jx = pair
+    i = dblp_small_hin.find_index_by_label("author", "Didier Dubois")
+    np.testing.assert_allclose(
+        jx.scores_from_source(i), oracle.scores_from_source(i), rtol=1e-6
+    )

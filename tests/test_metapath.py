@@ -1,0 +1,64 @@
+"""Metapath compiler tests."""
+
+import pytest
+
+from distributed_pathsim_tpu.data.schema import HINSchema
+from distributed_pathsim_tpu.ops.metapath import Step, compile_metapath
+
+DBLP = HINSchema(
+    node_types=("author", "paper", "venue", "topic"),
+    relations={
+        "author_of": ("author", "paper"),
+        "submit_at": ("paper", "venue"),
+        "has_topic": ("paper", "topic"),
+    },
+)
+
+
+def test_apvpa():
+    mp = compile_metapath("APVPA", DBLP)
+    assert mp.node_types == ("author", "paper", "venue", "paper", "author")
+    assert mp.steps == (
+        Step("author_of", False),
+        Step("submit_at", False),
+        Step("submit_at", True),
+        Step("author_of", True),
+    )
+    assert mp.is_symmetric
+    assert mp.half() == (Step("author_of", False), Step("submit_at", False))
+
+
+def test_apa():
+    mp = compile_metapath("APA", DBLP)
+    assert mp.is_symmetric
+    assert mp.half() == (Step("author_of", False),)
+
+
+def test_aptpa():
+    mp = compile_metapath("APTPA", DBLP)
+    assert mp.is_symmetric
+    assert [s.relationship for s in mp.steps] == [
+        "author_of", "has_topic", "has_topic", "author_of",
+    ]
+
+
+def test_asymmetric_path():
+    mp = compile_metapath("APV", DBLP)
+    assert not mp.is_symmetric
+    with pytest.raises(ValueError):
+        mp.half()
+
+
+def test_explicit_node_types():
+    mp = compile_metapath(["author", "paper", "author"], DBLP)
+    assert mp.name == "APA"
+    assert mp.is_symmetric
+
+
+def test_errors():
+    with pytest.raises(ValueError, match="unknown metapath letter"):
+        compile_metapath("AXA", DBLP)
+    with pytest.raises(ValueError, match="no relation connects"):
+        compile_metapath("AVA", DBLP)
+    with pytest.raises(ValueError, match="at least two"):
+        compile_metapath("A", DBLP)

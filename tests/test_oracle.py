@@ -1,0 +1,92 @@
+"""NumPy oracle vs the golden vectors of SURVEY.md Appendix A (formula
+verified against the reference's own run log, SURVEY.md §3.3)."""
+
+import numpy as np
+import pytest
+
+from distributed_pathsim_tpu.backends.base import create_backend
+from distributed_pathsim_tpu.ops.metapath import compile_metapath
+
+
+@pytest.fixture(scope="module")
+def oracle(dblp_small_hin):
+    mp = compile_metapath("APVPA", dblp_small_hin.schema)
+    return create_backend("numpy", dblp_small_hin, mp)
+
+
+def test_m_goldens(oracle):
+    m = oracle.commuting_matrix()
+    assert m.shape == (770, 770)
+    np.testing.assert_array_equal(m, m.T)  # symmetric
+    assert m.max() == 65
+    assert m.sum() == 79873
+    rs = oracle.global_walks()
+    np.testing.assert_allclose(rs, m.sum(axis=1))
+    assert rs.max() == 1396
+
+
+def test_didier_dubois_goldens(oracle, dblp_small_hin):
+    i = dblp_small_hin.find_index_by_label("author", "Didier Dubois")
+    assert i == 0
+    rs = oracle.global_walks()
+    m = oracle.commuting_matrix()
+    assert rs[i] == 3
+    assert m[i, i] == 1
+    scores = oracle.scores_from_source(i)
+    # self-sim under rowsum variant: 2*1/(3+3) = 1/3
+    assert scores[i] == pytest.approx(1 / 3)
+    j = dblp_small_hin.find_index_by_label("author", "Salem Benferhat")
+    k = dblp_small_hin.find_index_by_label("author", "Henri Prade")
+    assert scores[j] == pytest.approx(1 / 3)
+    assert scores[k] == pytest.approx(1 / 7)
+    checksum = scores.sum() - scores[i]
+    assert checksum == pytest.approx(10 / 21)
+
+
+def test_reference_log_formula_spot_checks(oracle):
+    """The reference log's arithmetic (dblp_large) — the formula must hold:
+    sim = 2*pw/(gs+gt). Spot-checked with the log's own numbers
+    (output/d_pathsim_output_20180417_020445.log:1-4, :207-209)."""
+    assert 2 * 10 / (8423 + 876) == pytest.approx(0.0021507688998817077, abs=0)
+    assert 2 * 10 / (8423 + 1295) == pytest.approx(0.0020580366330520683, abs=0)
+
+
+def test_pairwise_row_consistency(oracle):
+    m = oracle.commuting_matrix()
+    for s in (0, 17, 769):
+        np.testing.assert_array_equal(oracle.pairwise_row(s), m[s])
+
+
+def test_all_pairs_scores_properties(oracle):
+    s = oracle.all_pairs_scores()
+    # symmetry of sim under rowsum variant
+    np.testing.assert_allclose(s, s.T)
+    assert (s >= 0).all() and (s <= 1).all()
+
+
+def test_diagonal_variant(oracle):
+    """Textbook PathSim: diagonal normalization, self-sim exactly 1 where
+    defined."""
+    s = oracle.all_pairs_scores(variant="diagonal")
+    d = oracle.diagonal()
+    sd = np.diagonal(s)
+    assert np.all(sd[d > 0] == 1.0)
+
+
+def test_apa_metapath(dblp_small_hin):
+    """APA = co-authorship counts: M = A_AP @ A_APᵀ."""
+    mp = compile_metapath("APA", dblp_small_hin.schema)
+    b = create_backend("numpy", dblp_small_hin, mp)
+    a = dblp_small_hin.block("author_of").to_dense()
+    np.testing.assert_array_equal(b.commuting_matrix(), a @ a.T)
+
+
+def test_asymmetric_chain(dblp_small_hin):
+    """APV: author→venue path counts (asymmetric chain path)."""
+    mp = compile_metapath("APV", dblp_small_hin.schema)
+    b = create_backend("numpy", dblp_small_hin, mp)
+    a = dblp_small_hin.block("author_of").to_dense()
+    pv = dblp_small_hin.block("submit_at").to_dense()
+    np.testing.assert_array_equal(b.commuting_matrix(), a @ pv)
+    np.testing.assert_array_equal(b.global_walks(), (a @ pv).sum(axis=1))
+    np.testing.assert_array_equal(b.pairwise_row(5), (a @ pv)[5])

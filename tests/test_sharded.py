@@ -1,0 +1,61 @@
+"""Sharded backend on 8 virtual CPU devices: invariance vs single-device,
+padding correctness, ring == allgather (SURVEY.md §4 item 4)."""
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_pathsim_tpu.backends.base import create_backend
+from distributed_pathsim_tpu.ops.metapath import compile_metapath
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 virtual devices"
+)
+
+
+@pytest.fixture(scope="module")
+def mp(dblp_small_hin):
+    return compile_metapath("APVPA", dblp_small_hin.schema)
+
+
+@pytest.fixture(scope="module")
+def oracle(dblp_small_hin, mp):
+    return create_backend("numpy", dblp_small_hin, mp)
+
+
+def test_sharded_matches_oracle(dblp_small_hin, mp, oracle):
+    b = create_backend("jax-sharded", dblp_small_hin, mp, n_devices=8)
+    # 770 rows over 8 devices → padded to 776: padding must be invisible
+    np.testing.assert_array_equal(b.global_walks(), oracle.global_walks())
+    np.testing.assert_array_equal(b.commuting_matrix(), oracle.commuting_matrix())
+
+
+def test_ring_matches_allgather(dblp_small_hin, mp, oracle):
+    ring = create_backend(
+        "jax-sharded", dblp_small_hin, mp, n_devices=8, allpairs_strategy="ring"
+    )
+    np.testing.assert_array_equal(ring.commuting_matrix(), oracle.commuting_matrix())
+    np.testing.assert_array_equal(ring.global_walks(), oracle.global_walks())
+
+
+def test_device_count_invariance(dblp_small_hin, mp):
+    """Same answer on 1, 2, 8 devices — the sharding is semantically inert."""
+    results = []
+    for n in (1, 2, 8):
+        b = create_backend("jax-sharded", dblp_small_hin, mp, n_devices=n)
+        results.append(b.all_pairs_scores())
+    np.testing.assert_array_equal(results[0], results[1])
+    np.testing.assert_array_equal(results[0], results[2])
+
+
+def test_scores_match_oracle(dblp_small_hin, mp, oracle):
+    b = create_backend("jax-sharded", dblp_small_hin, mp, n_devices=8)
+    a, s = oracle.all_pairs_scores(), b.all_pairs_scores()
+    denom = np.maximum(np.abs(a), 1e-12)
+    assert np.max(np.abs(a - s) / denom) <= 1e-5
+
+
+def test_asymmetric_rejected(dblp_small_hin):
+    mp_asym = compile_metapath("APV", dblp_small_hin.schema)
+    with pytest.raises(ValueError, match="symmetric"):
+        create_backend("jax-sharded", dblp_small_hin, mp_asym)
