@@ -1,0 +1,53 @@
+"""Lazy native build: compile the C++ shared libraries on first use.
+
+No pybind11 in this image, so bindings go through a plain C ABI + ctypes.
+The build is a single g++ invocation per library, cached next to the
+source; failures degrade gracefully to the pure-Python implementations.
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+import subprocess
+import threading
+
+_DIR = pathlib.Path(__file__).resolve().parent
+_LOCK = threading.Lock()
+_BUILT: dict[str, pathlib.Path | None] = {}
+
+
+def shared_lib(name: str) -> pathlib.Path | None:
+    """Return the path to lib<name>.so, building it if needed.
+    None if the toolchain is missing or compilation fails."""
+    with _LOCK:
+        if name in _BUILT:
+            return _BUILT[name]
+        src = _DIR / f"{name}.cpp"
+        out = _DIR / f"lib{name}.so"
+        result: pathlib.Path | None = None
+        if src.exists():
+            if out.exists() and out.stat().st_mtime >= src.stat().st_mtime:
+                result = out
+            else:
+                # Compile to a process-unique temp path then atomically
+                # rename: a concurrent process never CDLLs a half-written
+                # .so (the in-process lock can't protect across processes).
+                tmp = out.with_suffix(f".tmp{os.getpid()}")
+                try:
+                    subprocess.run(
+                        [
+                            "g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                            str(src), "-o", str(tmp),
+                        ],
+                        check=True,
+                        capture_output=True,
+                        timeout=120,
+                    )
+                    os.replace(tmp, out)
+                    result = out
+                except (subprocess.SubprocessError, FileNotFoundError, OSError):
+                    tmp.unlink(missing_ok=True)
+                    result = None
+        _BUILT[name] = result
+        return result

@@ -1,16 +1,95 @@
-"""ctypes bridge to the C++ GEXF parser (built lazily from native/).
+"""ctypes bridge to the C++ GEXF parser (gexf_fast.cpp).
 
-Falls back cleanly when the shared library can't be built; see
-native/gexf_fast.cpp. For now this is a stub that reports unavailable —
-the build hook lands with the native milestone.
+Same output as data/gexf.py's Python parser — document order, dedup and
+attvalue semantics included — but a single native pass over the file.
+Falls back cleanly (available() → False) when the toolchain is missing.
 """
 
 from __future__ import annotations
 
+import ctypes
+
+from ..data.schema import Edge, HINGraph, Vertex
+from .build import shared_lib
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    path = shared_lib("gexf_fast")
+    if path is None:
+        return None
+    lib = ctypes.CDLL(str(path))
+    lib.gexf_parse.restype = ctypes.c_void_p
+    lib.gexf_parse.argtypes = [ctypes.c_char_p]
+    lib.gexf_num_nodes.restype = ctypes.c_long
+    lib.gexf_num_nodes.argtypes = [ctypes.c_void_p]
+    lib.gexf_num_edges.restype = ctypes.c_long
+    lib.gexf_num_edges.argtypes = [ctypes.c_void_p]
+    lib.gexf_nodes_blob.restype = ctypes.POINTER(ctypes.c_char)
+    lib.gexf_nodes_blob.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_long)]
+    lib.gexf_edges_blob.restype = ctypes.POINTER(ctypes.c_char)
+    lib.gexf_edges_blob.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_long)]
+    lib.gexf_graph_name.restype = ctypes.c_char_p
+    lib.gexf_graph_name.argtypes = [ctypes.c_void_p]
+    lib.gexf_error.restype = ctypes.c_char_p
+    lib.gexf_error.argtypes = [ctypes.c_void_p]
+    lib.gexf_free.restype = None
+    lib.gexf_free.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return lib
+
 
 def available() -> bool:
-    return False
+    return _load() is not None
 
 
-def read_gexf(path: str):
-    raise NotImplementedError("native GEXF parser not built")
+def read_gexf(path: str) -> HINGraph:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native GEXF parser unavailable")
+    handle = lib.gexf_parse(path.encode())
+    try:
+        err = lib.gexf_error(handle)
+        if err:
+            raise ValueError(f"GEXF parse error: {err.decode()}")
+        n_nodes = lib.gexf_num_nodes(handle)
+        n_edges = lib.gexf_num_edges(handle)
+        graph_name = (lib.gexf_graph_name(handle) or b"").decode("utf-8")
+
+        length = ctypes.c_long()
+        buf = lib.gexf_nodes_blob(handle, ctypes.byref(length))
+        node_fields = (
+            ctypes.string_at(buf, length.value).decode("utf-8").split("\0")
+            if length.value
+            else []
+        )
+        buf = lib.gexf_edges_blob(handle, ctypes.byref(length))
+        edge_fields = (
+            ctypes.string_at(buf, length.value).decode("utf-8").split("\0")
+            if length.value
+            else []
+        )
+    finally:
+        lib.gexf_free(handle)
+
+    # blobs end with a trailing NUL → drop the final empty split
+    if node_fields and node_fields[-1] == "":
+        node_fields.pop()
+    if edge_fields and edge_fields[-1] == "":
+        edge_fields.pop()
+    if len(node_fields) != 3 * n_nodes or len(edge_fields) != 3 * n_edges:
+        raise ValueError("native GEXF parser returned inconsistent blobs")
+
+    vertices = [
+        Vertex(id=node_fields[i], label=node_fields[i + 1], node_type=node_fields[i + 2])
+        for i in range(0, len(node_fields), 3)
+    ]
+    edges = [
+        Edge(src=edge_fields[i], dst=edge_fields[i + 1], relationship=edge_fields[i + 2])
+        for i in range(0, len(edge_fields), 3)
+    ]
+    return HINGraph(vertices=vertices, edges=edges, name=graph_name)

@@ -1,0 +1,112 @@
+"""Native (C++) GEXF parser vs the Python parser — must be identical."""
+
+import pytest
+
+from distributed_pathsim_tpu.data.gexf import _read_gexf_python, read_gexf
+from distributed_pathsim_tpu.native import gexf_native
+
+needs_native = pytest.mark.skipif(
+    not gexf_native.available(), reason="native toolchain unavailable"
+)
+
+
+@needs_native
+def test_native_matches_python_on_dblp(dblp_small_path):
+    py = _read_gexf_python(dblp_small_path)
+    nat = gexf_native.read_gexf(dblp_small_path)
+    assert [v.__dict__ for v in nat.vertices] == [v.__dict__ for v in py.vertices]
+    assert [e.__dict__ for e in nat.edges] == [e.__dict__ for e in py.edges]
+
+
+@needs_native
+def test_native_is_default_path(dblp_small_path):
+    g = read_gexf(dblp_small_path)  # auto-selects native when available
+    assert len(g.vertices) == 1866
+    assert len(g.edges) == 2266
+
+
+@needs_native
+def test_native_entities_and_dedup(tmp_path):
+    p = tmp_path / "esc.gexf"
+    p.write_text(
+        """<?xml version='1.0' encoding='utf-8'?>
+<gexf version="1.2" xmlns="http://www.gexf.net/1.2draft">
+  <graph defaultedgetype="directed" mode="static" name="">
+    <attributes class="edge" mode="static">
+      <attribute id="1" title="label" type="string" />
+    </attributes>
+    <attributes class="node" mode="static">
+      <attribute id="0" title="node_type" type="string" />
+    </attributes>
+    <nodes>
+      <node id="a1" label="Design &amp; Test &#233;"><attvalues><attvalue for="0" value="author" /></attvalues></node>
+      <node id="p1"><attvalues><attvalue for="0" value="paper" /></attvalues></node>
+    </nodes>
+    <edges>
+      <edge id="0" source="a1" target="p1"><attvalues><attvalue for="1" value="author_of" /></attvalues></edge>
+      <edge id="1" source="a1" target="p1"><attvalues><attvalue for="1" value="rewritten" /></attvalues></edge>
+    </edges>
+  </graph>
+</gexf>
+""",
+        encoding="utf-8",
+    )
+    py = _read_gexf_python(str(p))
+    nat = gexf_native.read_gexf(str(p))
+    assert nat.vertices[0].label == "Design & Test é"
+    assert nat.vertices[1].label == "p1"  # label falls back to id
+    # duplicate (src,dst): one edge, last relationship wins
+    assert len(nat.edges) == 1 and nat.edges[0].relationship == "rewritten"
+    assert [e.__dict__ for e in nat.edges] == [e.__dict__ for e in py.edges]
+    assert [v.__dict__ for v in nat.vertices] == [v.__dict__ for v in py.vertices]
+
+
+@needs_native
+def test_native_error_on_missing_file():
+    with pytest.raises(ValueError, match="cannot open"):
+        gexf_native.read_gexf("/nonexistent/file.gexf")
+
+
+@needs_native
+def test_native_on_synthetic_roundtrip(tmp_path):
+    from distributed_pathsim_tpu.data.synthetic import synthetic_hin, write_gexf
+
+    hin = synthetic_hin(40, 70, 5, seed=9, materialize_ids=True)
+    p = tmp_path / "syn.gexf"
+    write_gexf(hin, str(p))
+    py = _read_gexf_python(str(p))
+    nat = gexf_native.read_gexf(str(p))
+    assert [v.__dict__ for v in nat.vertices] == [v.__dict__ for v in py.vertices]
+    assert [e.__dict__ for e in nat.edges] == [e.__dict__ for e in py.edges]
+
+
+@needs_native
+def test_native_semantic_corners(tmp_path):
+    """Divergence regressions: graph name, undeclared attr ids, empty
+    label attribute, repeated attvalues (last wins)."""
+    p = tmp_path / "corner.gexf"
+    p.write_text(
+        """<?xml version='1.0'?>
+<gexf version="1.2">
+  <graph defaultedgetype="directed" name="my graph &amp; co">
+    <nodes>
+      <node id="a1" label=""><attvalues><attvalue for="node_type" value="author" /></attvalues></node>
+      <node id="p1" label="P"><attvalues><attvalue for="node_type" value="paper" /></attvalues></node>
+    </nodes>
+    <edges>
+      <edge id="0" source="a1" target="p1"><attvalues>
+        <attvalue for="label" value="first" />
+        <attvalue for="label" value="last" />
+      </attvalues></edge>
+    </edges>
+  </graph>
+</gexf>
+""",
+        encoding="utf-8",
+    )
+    py = _read_gexf_python(str(p))
+    nat = gexf_native.read_gexf(str(p))
+    assert nat.name == py.name == "my graph & co"
+    assert nat.vertices[0].label == py.vertices[0].label == ""
+    assert nat.vertices[0].node_type == py.vertices[0].node_type == "author"
+    assert nat.edges[0].relationship == py.edges[0].relationship == "last"
