@@ -6,6 +6,10 @@ accumulation — exact for integer path counts below 2²⁴ (dblp-scale row
 sums are ≤ ~1.2e4; validity is asserted, not assumed). ``highest``
 matmul precision keeps the MXU from silently dropping to bf16 inputs,
 which WOULD truncate counts ≥ 257 (SURVEY.md §7).
+
+All-pairs scoring runs fully on device: the pallas fused
+matmul+normalize kernel on TPU (M never hits HBM), the equivalent XLA
+program elsewhere — the host only receives the final score matrix.
 """
 
 from __future__ import annotations
@@ -17,6 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops import chain
+from ..ops import pallas_kernels as pk
 from .base import PathSimBackend, register_backend
 
 # f32 represents every integer exactly up to 2**24.
@@ -25,7 +30,7 @@ _F32_EXACT_INT_MAX = float(2**24)
 
 @functools.partial(jax.jit, static_argnames=("symmetric",))
 def _chain_outputs(blocks, symmetric: bool):
-    """Compute (M, rowsums) for the oriented chain on device.
+    """(M, rowsums) for the oriented chain, on device.
 
     ``highest`` matmul precision: counts are integers, bf16-pass matmuls
     would truncate them.
@@ -41,13 +46,32 @@ def _chain_outputs(blocks, symmetric: bool):
     return m, rowsums
 
 
+@jax.jit
+def _half_outputs(blocks):
+    """(C, rowsums) for a SYMMETRIC chain without materializing M — feeds
+    the fused score/topk path."""
+    with jax.default_matmul_precision("highest"):
+        c = chain.half_product(blocks, xp=jnp)
+        return c, chain.rowsums_from_half(c, xp=jnp)
+
+
+@jax.jit
+def _rowsums_asym(blocks):
+    """Row sums of an arbitrary chain by folding the ones-vector from the
+    right — never materializes anything wider than a block."""
+    with jax.default_matmul_precision("highest"):
+        return chain.rowsums_general(blocks, xp=jnp)
+
+
 @register_backend("jax")
 class JaxDenseBackend(PathSimBackend):
     """Dense chain on one device (TPU when available, else host backend)."""
 
-    def __init__(self, hin, metapath, dtype=jnp.float32, device=None, **options):
+    def __init__(self, hin, metapath, dtype=jnp.float32, device=None,
+                 use_pallas: bool | None = None, **options):
         super().__init__(hin, metapath, **options)
         self.dtype = dtype
+        self.use_pallas = pk.pallas_supported() if use_pallas is None else use_pallas
         steps = metapath.half() if metapath.is_symmetric else metapath.steps
         host_blocks = chain.oriented_dense_blocks(hin, steps, dtype=np.float32)
         self._blocks = [
@@ -62,18 +86,62 @@ class JaxDenseBackend(PathSimBackend):
             m, rowsums = _chain_outputs(self._blocks, self._symmetric)
             self._m = np.asarray(m, dtype=np.float64)
             self._rowsums = np.asarray(rowsums, dtype=np.float64)
-            if self.dtype == jnp.float32 and self._rowsums.max(initial=0.0) >= _F32_EXACT_INT_MAX:
-                raise OverflowError(
-                    "path counts exceed f32 exact-integer range (2^24); "
-                    "rerun with dtype=jnp.float64 (requires JAX_ENABLE_X64)"
-                )
+            self._check_exact(self._rowsums)
         return self._m, self._rowsums
+
+    def _check_exact(self, rowsums: np.ndarray) -> None:
+        if self.dtype == jnp.float32 and rowsums.max(initial=0.0) >= _F32_EXACT_INT_MAX:
+            raise OverflowError(
+                "path counts exceed f32 exact-integer range (2^24); "
+                "rerun with dtype=jnp.float64 (requires JAX_ENABLE_X64)"
+            )
 
     def commuting_matrix(self) -> np.ndarray:
         return self._compute()[0]
 
     def global_walks(self) -> np.ndarray:
-        return self._compute()[1]
+        if self._rowsums is None and self._m is None:
+            # cheap path: rowsums without materializing M
+            if self._symmetric:
+                _, rowsums = _half_outputs(self._blocks)
+            else:
+                rowsums = _rowsums_asym(self._blocks)
+            self._rowsums = np.asarray(rowsums, dtype=np.float64)
+            self._check_exact(self._rowsums)
+        elif self._rowsums is None:
+            self._compute()
+        return self._rowsums
 
     def pairwise_row(self, source_index: int) -> np.ndarray:
         return self._compute()[0][source_index]
+
+    # -- on-device scoring fast paths -------------------------------------
+
+    def all_pairs_scores(self, variant: str = "rowsum") -> np.ndarray:
+        if not self._symmetric or variant != "rowsum":
+            return super().all_pairs_scores(variant)
+        c, rowsums = _half_outputs(self._blocks)
+        self._rowsums = np.asarray(rowsums, dtype=np.float64)
+        self._check_exact(self._rowsums)
+        if self.use_pallas and pk.fits_vmem(c.shape[1]):
+            scores = pk.fused_scores(c, rowsums)
+        else:
+            scores = pk.fused_scores_reference(c, rowsums)
+        return np.asarray(scores)
+
+    def topk(self, k: int = 10, mask_self: bool = True):
+        """Per-source top-k (values, indices), fully on device."""
+        if not self._symmetric:
+            raise ValueError("topk fast path requires a symmetric metapath")
+        c, rowsums = _half_outputs(self._blocks)
+        self._rowsums = np.asarray(rowsums, dtype=np.float64)
+        self._check_exact(self._rowsums)
+        if self.use_pallas and pk.fits_vmem(c.shape[1]):
+            vals, idxs = pk.fused_topk(c, rowsums, k=k, mask_self=mask_self)
+        else:
+            scores = pk.fused_scores_reference(c, rowsums)
+            if mask_self:
+                n = scores.shape[0]
+                scores = jnp.where(jnp.eye(n, dtype=bool), -jnp.inf, scores)
+            vals, idxs = jax.lax.top_k(scores, k)
+        return np.asarray(vals), np.asarray(idxs)

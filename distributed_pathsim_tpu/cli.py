@@ -31,7 +31,17 @@ def build_parser() -> argparse.ArgumentParser:
         choices=available_backends(),
         help="execution backend",
     )
-    p.add_argument("--metapath", default="APVPA", help="metapath spec, e.g. APVPA")
+    p.add_argument(
+        "--metapath",
+        default="APVPA",
+        help="metapath spec, e.g. APVPA; comma-separate several "
+        "(e.g. APVPA,APTPA,APA) for batched multi-path scoring",
+    )
+    p.add_argument(
+        "--weights",
+        default=None,
+        help="comma-separated per-metapath ensemble weights (multi-path mode)",
+    )
     p.add_argument("--variant", default="rowsum", choices=list(VARIANTS))
     p.add_argument("--source", default=None, help="source node label (e.g. author name)")
     p.add_argument("--source-id", default=None, help="source node id (e.g. author_395340)")
@@ -57,6 +67,8 @@ def main(argv: list[str] | None = None) -> int:
 
 
 def _run(args) -> int:
+    if "," in args.metapath:
+        return _run_multipath(args)
     config = RunConfig(
         dataset=args.dataset,
         backend=args.backend,
@@ -110,6 +122,71 @@ def _run(args) -> int:
               f"max offdiag={_max_offdiag(scores):.6g}")
         ran = True
 
+    if not ran:
+        print("Nothing to do: pass --source/--source-id and/or --all-pairs",
+              file=sys.stderr)
+        return 2
+    return 0
+
+
+def _run_multipath(args) -> int:
+    """Batched multi-metapath mode: per-path + combined scores, top-k."""
+    from .engine import load_dataset
+    from .models.multipath import MultiMetapathScorer
+
+    # The batched scorer is a fixed jax/rowsum pipeline; reject flags it
+    # would otherwise silently ignore.
+    unsupported = {
+        "--variant": args.variant != "rowsum",
+        "--backend": args.backend != "jax",
+        "--dtype": args.dtype != "float32",
+        "--n-devices": args.n_devices is not None,
+        "--output": args.output is not None,
+        "--metrics": args.metrics is not None,
+    }
+    bad = [flag for flag, hit in unsupported.items() if hit]
+    if bad:
+        raise ValueError(
+            f"multi-metapath mode does not support {', '.join(bad)} "
+            "(it always runs the batched jax rowsum-variant scorer)"
+        )
+
+    hin = load_dataset(args.dataset)
+    names = [s.strip() for s in args.metapath.split(",") if s.strip()]
+    weights = (
+        [float(w) for w in args.weights.split(",")] if args.weights else None
+    )
+    scorer = MultiMetapathScorer(hin, names)
+    if not args.quiet:
+        print(f"Batched metapaths: {scorer.names}")
+        gw = scorer.global_walks()
+        for r, name in enumerate(scorer.names):
+            print(f"  {name}: max global walk {int(gw[r].max())}")
+
+    ran = False
+    if args.source or args.source_id:
+        node_type = scorer.metapaths[0].source_type
+        idx = (
+            hin.find_index_by_label(node_type, args.source)
+            if args.source
+            else hin.indices[node_type].index_of.get(args.source_id)
+        )
+        if idx is None:
+            raise KeyError(f"unknown {node_type} {args.source or args.source_id!r}")
+        k = args.top_k or 10
+        vals, idxs = scorer.topk_row(idx, k=k, weights=weights)
+        labels = hin.indices[node_type].labels
+        print(f"Top-{k} similar to {labels[idx]} (combined {scorer.names}):")
+        for v, j in zip(vals, idxs):
+            print(f"  {v:.6f}  {labels[j]} ({hin.indices[node_type].ids[j]})")
+        ran = True
+    if args.all_pairs:
+        comb = scorer.combined_scores(weights)
+        print(
+            f"Combined all-pairs scores: {comb.shape[0]}x{comb.shape[1]}, "
+            f"mean={comb.mean():.6g}, max offdiag={_max_offdiag(comb):.6g}"
+        )
+        ran = True
     if not ran:
         print("Nothing to do: pass --source/--source-id and/or --all-pairs",
               file=sys.stderr)

@@ -1,0 +1,214 @@
+"""Pallas TPU kernels for the PathSim hot path.
+
+The framework's FLOPs live in ``M = C @ Cᵀ`` followed by the elementwise
+normalization ``S = 2M / (d_i + d_j)`` (reference semantics, SURVEY.md
+§3.3). Done naively, M (N×N) is written to HBM by the matmul and read
+back by the normalize — at 10k+ authors that traffic dominates. The fused
+kernel computes each [bm × bn] tile of M on the MXU and normalizes it in
+VMEM before it ever leaves the chip: M never exists in HBM.
+
+Also here: a fused top-k variant that reduces each row tile to its k best
+scores on-chip (for the million-author regime where even S is too big to
+materialize).
+
+All kernels are f32 with f32 accumulation (integer path counts — bf16
+would truncate, SURVEY.md §7) and have jnp reference implementations used
+as CPU fallbacks and test oracles.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+# Tile sizes: multiples of the f32 (8, 128) VMEM tile; 256×256 output
+# tiles keep C tiles + out tile well under VMEM while saturating the MXU.
+_BM = 256
+_BN = 256
+
+
+def _ceil_to(x: int, k: int) -> int:
+    return ((x + k - 1) // k) * k
+
+
+def _scores_kernel(c_i_ref, c_j_ref, d_i_ref, d_j_ref, out_ref):
+    """One [bm, bn] tile: matmul on MXU + normalization in VMEM.
+
+    HIGHEST precision forces full-f32 MXU passes: path counts are
+    integers, and the default bf16 passes truncate counts ≥ 257.
+    """
+    m = jnp.dot(
+        c_i_ref[:],
+        c_j_ref[:].T,
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    denom = d_i_ref[:] + d_j_ref[:].T  # [bm,1] + [1,bn]
+    out_ref[:] = jnp.where(denom > 0, 2.0 * m / jnp.where(denom > 0, denom, 1.0), 0.0)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def fused_scores(c: jax.Array, rowsums: jax.Array, interpret: bool = False):
+    """All-pairs PathSim scores from the half-chain factor, fused.
+
+    c: [N, V] f32, rowsums: [N] f32 → scores [N, N] f32.
+    Rows are padded to the tile size inside; padded rows have rowsum 0 and
+    produce score 0 (the where-guard), then are sliced away.
+    """
+    n, v = c.shape
+    n_pad = _ceil_to(max(n, 8), _BM)
+    v_pad = _ceil_to(max(v, 128), 128)
+    c_p = jnp.zeros((n_pad, v_pad), dtype=jnp.float32).at[:n, :v].set(c)
+    d_p = jnp.zeros((n_pad, 1), dtype=jnp.float32).at[:n, 0].set(rowsums)
+
+    grid = (n_pad // _BM, n_pad // _BN)
+    out = pl.pallas_call(
+        _scores_kernel,
+        out_shape=jax.ShapeDtypeStruct((n_pad, n_pad), jnp.float32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_BM, v_pad), lambda i, j: (i, 0)),
+            pl.BlockSpec((_BN, v_pad), lambda i, j: (j, 0)),
+            pl.BlockSpec((_BM, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((_BN, 1), lambda i, j: (j, 0)),
+        ],
+        out_specs=pl.BlockSpec((_BM, _BN), lambda i, j: (i, j)),
+        interpret=interpret,
+    )(c_p, c_p, d_p, d_p)
+    return out[:n, :n]
+
+
+@jax.jit
+def fused_scores_reference(c: jax.Array, rowsums: jax.Array):
+    """Pure-XLA fallback with identical semantics (CPU, or no-pallas)."""
+    with jax.default_matmul_precision("highest"):
+        m = jnp.matmul(c, c.T)
+    denom = rowsums[:, None] + rowsums[None, :]
+    return jnp.where(denom > 0, 2.0 * m / jnp.where(denom > 0, denom, 1.0), 0.0)
+
+
+def _topk_kernel(k: int, mask_self: bool, n_true: int, c_i_ref, c_j_ref,
+                 d_i_ref, d_j_ref, vals_ref, idxs_ref):
+    """Row-tile top-k: fold each [bm, bn] score tile into the running
+    [bm, k_pad] best values/indices. Grid is (rows, cols) with cols
+    innermost; the running state lives in the output refs (same row block
+    for every j step, so revisiting is safe).
+
+    ``lax.top_k`` has no Pallas TPU lowering, so selection is k unrolled
+    rounds of max-extract over the merged candidates — pure VPU reductions
+    (k is small; each round is O(bm·(k_pad+bn)) vector work).
+    """
+    j = pl.program_id(1)
+
+    m = jnp.dot(
+        c_i_ref[:],
+        c_j_ref[:].T,
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    denom = d_i_ref[:] + d_j_ref[:].T
+    s = jnp.where(denom > 0, 2.0 * m / jnp.where(denom > 0, denom, 1.0), 0.0)
+    bm, bn = s.shape
+    col_base = j * bn
+    cols = col_base + jax.lax.broadcasted_iota(jnp.int32, (bm, bn), 1)
+    # Only PADDING columns (index ≥ n_true) are ruled out with -inf; real
+    # zero-degree targets keep score 0 exactly like the unfused oracle.
+    s = jnp.where(cols < n_true, s, -jnp.inf)
+    if mask_self:
+        i = pl.program_id(0)
+        rows = i * bm + jax.lax.broadcasted_iota(jnp.int32, (bm, bn), 0)
+        s = jnp.where(rows == cols, -jnp.inf, s)
+
+    @pl.when(j == 0)
+    def _init():
+        vals_ref[:] = jnp.full_like(vals_ref, -jnp.inf)
+        idxs_ref[:] = jnp.zeros_like(idxs_ref)
+
+    merged_v = jnp.concatenate([vals_ref[:], s], axis=1)
+    merged_i = jnp.concatenate([idxs_ref[:], cols], axis=1)
+    mcols = jax.lax.broadcasted_iota(jnp.int32, merged_v.shape, 1)
+    out_col = jax.lax.broadcasted_iota(jnp.int32, (bm, vals_ref.shape[1]), 1)
+    new_v = jnp.full((bm, vals_ref.shape[1]), -jnp.inf, dtype=vals_ref.dtype)
+    new_i = jnp.zeros((bm, idxs_ref.shape[1]), dtype=idxs_ref.dtype)
+    big = jnp.int32(2**30)
+    for t in range(k):
+        vmax = jnp.max(merged_v, axis=1, keepdims=True)
+        # first column achieving the max (deterministic tie-break)
+        pos = jnp.min(
+            jnp.where(merged_v == vmax, mcols, big), axis=1, keepdims=True
+        )
+        imax = jnp.max(
+            jnp.where(mcols == pos, merged_i, jnp.int32(0)), axis=1, keepdims=True
+        )
+        new_v = jnp.where(out_col == t, vmax, new_v)
+        new_i = jnp.where(out_col == t, imax, new_i)
+        merged_v = jnp.where(mcols == pos, -jnp.inf, merged_v)
+    vals_ref[:] = new_v
+    idxs_ref[:] = new_i
+
+
+@functools.partial(jax.jit, static_argnames=("k", "mask_self", "interpret"))
+def fused_topk(
+    c: jax.Array,
+    rowsums: jax.Array,
+    k: int = 10,
+    mask_self: bool = True,
+    interpret: bool = False,
+):
+    """Per-row top-k scores without materializing the score matrix.
+
+    Returns (values [N, k] f32, indices [N, k] int32).
+    """
+    n, v = c.shape
+    n_pad = _ceil_to(max(n, 8), _BM)
+    v_pad = _ceil_to(max(v, 128), 128)
+    k_pad = _ceil_to(k, 128)  # lane-aligned output minor dim
+    c_p = jnp.zeros((n_pad, v_pad), dtype=jnp.float32).at[:n, :v].set(c)
+    d_p = jnp.zeros((n_pad, 1), dtype=jnp.float32).at[:n, 0].set(rowsums)
+
+    grid = (n_pad // _BM, n_pad // _BN)
+    vals, idxs = pl.pallas_call(
+        functools.partial(_topk_kernel, k, mask_self, n),
+        out_shape=(
+            jax.ShapeDtypeStruct((n_pad, k_pad), jnp.float32),
+            jax.ShapeDtypeStruct((n_pad, k_pad), jnp.int32),
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_BM, v_pad), lambda i, j: (i, 0)),
+            pl.BlockSpec((_BN, v_pad), lambda i, j: (j, 0)),
+            pl.BlockSpec((_BM, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((_BN, 1), lambda i, j: (j, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((_BM, k_pad), lambda i, j: (i, 0)),
+            pl.BlockSpec((_BM, k_pad), lambda i, j: (i, 0)),
+        ),
+        interpret=interpret,
+    )(c_p, c_p, d_p, d_p)
+    return vals[:n, :k], idxs[:n, :k]
+
+
+def pallas_supported() -> bool:
+    """Pallas TPU kernels need a real TPU backend; elsewhere callers use
+    interpret mode (tests) or the XLA reference."""
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+# VMEM is ~16 MB/core; each grid step holds two [tile, v_pad] C blocks
+# plus the output tile. The kernels do not (yet) tile the contraction
+# dim, so wide half-chain factors (e.g. APA's author×paper C) must take
+# the XLA path instead of overflowing VMEM.
+_VMEM_BUDGET_BYTES = 12 << 20
+
+
+def fits_vmem(v: int) -> bool:
+    v_pad = _ceil_to(max(v, 128), 128)
+    needed = (_BM + _BN) * v_pad * 4 + _BM * _BN * 4
+    return needed <= _VMEM_BUDGET_BYTES

@@ -69,3 +69,38 @@ def test_dtype_flag_plumbs_through(dblp_small_path, tmp_path):
     ])
     assert rc == 0
     assert "Source author global walk: 3" in out.read_text()
+
+
+def test_multipath_mode(dblp_small_path, capsys):
+    rc = main([
+        "--dataset", dblp_small_path,
+        "--metapath", "APVPA,APA",
+        "--source", "Didier Dubois",
+        "--top-k", "3",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Batched metapaths: ['APVPA', 'APA']" in out
+    assert "Salem Benferhat" in out
+
+
+def test_multipath_weights(dblp_small_path, capsys):
+    rc = main([
+        "--dataset", dblp_small_path,
+        "--metapath", "APVPA,APA",
+        "--weights", "1.0,0.0",
+        "--all-pairs", "--quiet",
+    ])
+    assert rc == 0
+    assert "Combined all-pairs scores: 770x770" in capsys.readouterr().out
+
+
+def test_multipath_rejects_unsupported_flags(dblp_small_path, capsys):
+    rc = main([
+        "--dataset", dblp_small_path,
+        "--metapath", "APVPA,APA",
+        "--variant", "diagonal",
+        "--all-pairs", "--quiet",
+    ])
+    assert rc == 1
+    assert "--variant" in capsys.readouterr().err

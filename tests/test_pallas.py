@@ -1,0 +1,113 @@
+"""Pallas fused kernels vs oracle — interpret mode on CPU (SURVEY.md §4:
+same kernels run compiled on real TPU; bench exercises that path)."""
+
+import numpy as np
+import pytest
+
+from distributed_pathsim_tpu.backends.base import create_backend
+from distributed_pathsim_tpu.ops import pallas_kernels as pk
+from distributed_pathsim_tpu.ops.metapath import compile_metapath
+
+
+@pytest.fixture(scope="module")
+def cd(dblp_small_hin):
+    import jax.numpy as jnp
+
+    mp = compile_metapath("APVPA", dblp_small_hin.schema)
+    ap = dblp_small_hin.block("author_of").to_dense(np.float32)
+    pv = dblp_small_hin.block("submit_at").to_dense(np.float32)
+    c = np.asarray(ap @ pv, dtype=np.float32)
+    rowsums = np.asarray(c @ c.sum(axis=0), dtype=np.float32)
+    oracle = create_backend("numpy", dblp_small_hin, mp)
+    return jnp.asarray(c), jnp.asarray(rowsums), oracle
+
+
+def test_fused_scores_interpret(cd):
+    c, d, oracle = cd
+    got = np.asarray(pk.fused_scores(c, d, interpret=True), dtype=np.float64)
+    want = oracle.all_pairs_scores()
+    np.testing.assert_allclose(got, want, atol=1e-7)
+
+
+def test_fused_scores_xla_reference(cd):
+    c, d, oracle = cd
+    got = np.asarray(pk.fused_scores_reference(c, d), dtype=np.float64)
+    np.testing.assert_allclose(got, oracle.all_pairs_scores(), atol=1e-7)
+
+
+def test_fused_topk_interpret(cd):
+    c, d, oracle = cd
+    vals, idxs = pk.fused_topk(c, d, k=5, interpret=True)
+    scores = oracle.all_pairs_scores()
+    np.fill_diagonal(scores, -np.inf)
+    for i in (0, 3, 100, 769):
+        expect = np.sort(scores[i])[::-1][:5]
+        np.testing.assert_allclose(np.asarray(vals[i], dtype=np.float64), expect,
+                                   atol=1e-7)
+        # indices must point at rows achieving those scores
+        np.testing.assert_allclose(
+            scores[i][np.asarray(idxs[i])], expect, atol=1e-7
+        )
+
+
+def test_fused_topk_no_self_mask(cd):
+    c, d, oracle = cd
+    vals, idxs = pk.fused_topk(c, d, k=1, mask_self=False, interpret=True)
+    # with self-pairs allowed, Didier Dubois's best match is himself (1/3)
+    assert idxs[0, 0] == 0
+    assert vals[0, 0] == pytest.approx(1 / 3, abs=1e-7)
+
+
+def test_padding_rows_are_invisible():
+    """Shapes far from tile multiples + zero-degree rows."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    n, v = 130, 7
+    c = (rng.random((n, v)) < 0.3).astype(np.float32)
+    c[5] = 0  # isolated author: rowsum 0 → all scores 0
+    d = (c @ c.sum(axis=0)).astype(np.float32)
+    got = np.asarray(pk.fused_scores(jnp.asarray(c), jnp.asarray(d), interpret=True))
+    m = c.astype(np.float64) @ c.astype(np.float64).T
+    dd = m.sum(axis=1)
+    denom = dd[:, None] + dd[None, :]
+    want = np.where(denom > 0, 2 * m / np.where(denom > 0, denom, 1), 0.0)
+    np.testing.assert_allclose(got, want, atol=1e-6)
+    assert (got[5] == 0).all()
+
+
+def test_backend_fused_path_matches_base(dblp_small_hin):
+    mp = compile_metapath("APVPA", dblp_small_hin.schema)
+    jx = create_backend("jax", dblp_small_hin, mp)  # use_pallas auto→False on CPU
+    oracle = create_backend("numpy", dblp_small_hin, mp)
+    np.testing.assert_allclose(
+        jx.all_pairs_scores().astype(np.float64),
+        oracle.all_pairs_scores(),
+        atol=1e-7,
+    )
+    vals, idxs = jx.topk(k=3)
+    scores = oracle.all_pairs_scores()
+    np.fill_diagonal(scores, -np.inf)
+    np.testing.assert_allclose(
+        vals[0].astype(np.float64), np.sort(scores[0])[::-1][:3], atol=1e-7
+    )
+
+
+def test_fused_topk_zero_degree_targets_score_zero():
+    """Zero-degree targets must appear with score 0 (like the oracle),
+    not be masked out as padding."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(1)
+    n, v = 12, 4
+    c = (rng.random((n, v)) < 0.4).astype(np.float32)
+    c[5] = 0  # isolated node
+    d = (c @ c.sum(axis=0)).astype(np.float32)
+    k = n - 1
+    vals, idxs = pk.fused_topk(jnp.asarray(c), jnp.asarray(d), k=k, interpret=True)
+    # every row's candidate set must include node 5 with score 0
+    for i in range(n):
+        if i == 5:
+            continue
+        row = dict(zip(np.asarray(idxs[i]).tolist(), np.asarray(vals[i]).tolist()))
+        assert row.get(5) == 0.0
