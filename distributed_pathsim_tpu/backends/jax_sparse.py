@@ -92,12 +92,41 @@ class JaxSparseBackend(PathSimBackend):
             out[j * t.tile_rows : (j + 1) * t.tile_rows] = tile[0]
         return out[: self.n]
 
-    def topk_scores(self, k: int = 10, variant: str = "rowsum"):
+    def _run_config(self, k: int) -> dict:
+        """Checkpoint identity: graph fingerprint + tiling + k. A reused
+        directory from a different run must fail, not resume."""
+        c = self._c
+        digest = int(
+            (c.rows * 2654435761 + c.cols * 40503 + c.weights.astype(np.int64))
+            .sum() % (1 << 53)
+        )
+        return {
+            "n": int(self.n),
+            "v": int(c.shape[1]),
+            "nnz": int(c.rows.shape[0]),
+            "digest": digest,
+            "tile_rows": int(self.tiled.tile_rows),
+            "k": int(k),
+            "metapath": self.metapath.name,
+        }
+
+    def topk_scores(self, k: int = 10, variant: str = "rowsum",
+                    checkpoint_dir: str | None = None):
         """Streaming per-source top-k over row tiles: never materializes
         more than one [tile, tile] score block. Returns (values, indices)
-        arrays of shape [N, k]."""
+        arrays of shape [N, k].
+
+        ``checkpoint_dir``: persist each completed row tile and skip it on
+        restart — the all-pairs analog of the reference's per-stage
+        append-and-flush crash resilience (SURVEY.md §5).
+        """
         if variant != "rowsum":
             raise ValueError("streaming top-k supports the rowsum variant")
+        ckpt = None
+        if checkpoint_dir is not None:
+            from ..utils.checkpoint import CheckpointManager
+
+            ckpt = CheckpointManager(checkpoint_dir, config=self._run_config(k))
         t = self.tiled
         d = self.global_walks()
         d_pad = np.zeros(t.n_tiles * t.tile_rows)
@@ -106,6 +135,13 @@ class JaxSparseBackend(PathSimBackend):
         idxs = np.zeros((self.n, k), dtype=np.int64)
         for i in range(t.n_tiles):
             i0 = i * t.tile_rows
+            rows_here = min(t.tile_rows, self.n - i0)
+            key = f"topk{k}_rowtile_{i}"
+            if ckpt is not None and ckpt.is_done(key):
+                unit = ckpt.load_unit(key)
+                vals[i0 : i0 + rows_here] = unit["vals"]
+                idxs[i0 : i0 + rows_here] = unit["idxs"]
+                continue
             di = d_pad[i0 : i0 + t.tile_rows]
             best_v = np.full((t.tile_rows, k), -np.inf)
             best_i = np.zeros((t.tile_rows, k), dtype=np.int64)
@@ -127,7 +163,10 @@ class JaxSparseBackend(PathSimBackend):
                 top = np.argsort(-merged_v, axis=1, kind="stable")[:, :k]
                 best_v = np.take_along_axis(merged_v, top, axis=1)
                 best_i = np.take_along_axis(merged_i, top, axis=1)
-            rows_here = min(t.tile_rows, self.n - i0)
             vals[i0 : i0 + rows_here] = best_v[:rows_here]
             idxs[i0 : i0 + rows_here] = best_i[:rows_here]
+            if ckpt is not None:
+                ckpt.save_unit(
+                    key, vals=best_v[:rows_here], idxs=best_i[:rows_here]
+                )
         return vals, idxs

@@ -52,12 +52,21 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--n-devices", type=int, default=None, help="devices for sharded backends")
     p.add_argument("--dtype", default="float32", help="device dtype (float64 needs JAX_ENABLE_X64)")
     p.add_argument("--quiet", action="store_true", help="suppress stdout echo")
+    p.add_argument(
+        "--profile-dir",
+        default=None,
+        help="write a jax.profiler device trace (TensorBoard/Perfetto) here",
+    )
     return p
 
 
 def main(argv: list[str] | None = None) -> int:
     try:
-        return _run(build_parser().parse_args(argv))
+        args = build_parser().parse_args(argv)
+        from .utils.profiling import device_trace
+
+        with device_trace(args.profile_dir):
+            return _run(args)
     except (KeyError, ValueError, OverflowError, FileNotFoundError) as exc:
         # Known, user-actionable failures render as one clean line; anything
         # unexpected still gets a full traceback.

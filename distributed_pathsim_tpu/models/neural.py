@@ -1,0 +1,187 @@
+"""Neural PathSim: learned embeddings that approximate metapath similarity.
+
+Exact PathSim ranks with O(N·V) work per query and cannot score nodes
+added after encoding. Following the Neural-PathSim idea (inductive
+similarity search in HINs — see PAPERS.md; pattern only, clean-room
+implementation), a two-tower MLP maps each node's metapath feature
+vector (its row of the half-chain factor C, degree-normalized) to a
+d-dim embedding trained so that  σ-free inner products reproduce the
+exact PathSim scores computed by this framework's own backends. Queries
+become O(d) dot products; unseen nodes embed through the same tower.
+
+Training is TPU-native data parallelism: the pair batch is sharded over
+the ``dp`` mesh axis via explicit shardings on a jit'd optax step —
+XLA inserts the gradient psum. The same step runs on one chip, 8 virtual
+CPU devices (tests), or a real slice.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..data.encode import EncodedHIN
+from ..ops import chain
+from ..ops.metapath import MetaPath, compile_metapath
+
+
+class TwoTower(nn.Module):
+    """Shared-weight encoder tower: features → embedding."""
+
+    hidden: int = 128
+    dim: int = 64
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Dense(self.hidden)(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.hidden)(x)
+        x = nn.relu(x)
+        return nn.Dense(self.dim)(x)
+
+
+@dataclasses.dataclass
+class TrainState:
+    params: dict
+    opt_state: optax.OptState
+    step: int = 0
+
+
+class NeuralPathSim:
+    """Trainer + index for embedding-based PathSim approximation."""
+
+    def __init__(
+        self,
+        hin: EncodedHIN,
+        metapath: MetaPath | str,
+        dim: int = 64,
+        hidden: int = 128,
+        lr: float = 1e-3,
+        mesh: Mesh | None = None,
+        seed: int = 0,
+    ):
+        self.hin = hin
+        self.metapath = (
+            compile_metapath(metapath, hin.schema)
+            if isinstance(metapath, str)
+            else metapath
+        )
+        if not self.metapath.is_symmetric:
+            raise ValueError("NeuralPathSim needs a symmetric metapath")
+        self.mesh = mesh
+
+        blocks = chain.oriented_dense_blocks(
+            hin, self.metapath.half(), dtype=np.float32
+        )
+        c = blocks[0]
+        for b in blocks[1:]:
+            c = c @ b
+        self.n, self.v = c.shape
+        # exact targets (rowsum-variant PathSim) from the oracle chain
+        from ..ops.pathsim import score_matrix
+
+        c64 = c.astype(np.float64)
+        self._scores = score_matrix(c64 @ c64.T, variant="rowsum", xp=np)
+        # nonzero pairs, precomputed once: positive-sample pool for training
+        self._pos_i, self._pos_j = np.nonzero(self._scores)
+        # features: degree-normalized C rows (unit L2 where nonzero)
+        norms = np.linalg.norm(c, axis=1, keepdims=True)
+        self.features = (c / np.where(norms > 0, norms, 1)).astype(np.float32)
+
+        self.model = TwoTower(hidden=hidden, dim=dim)
+        rng = jax.random.PRNGKey(seed)
+        params = self.model.init(rng, jnp.zeros((1, self.v), jnp.float32))
+        self.tx = optax.adam(lr)
+        self.state = TrainState(params=params, opt_state=self.tx.init(params))
+        self._train_step = self._build_train_step()
+
+    # -- training ----------------------------------------------------------
+
+    def _build_train_step(self):
+        model, tx = self.model, self.tx
+
+        def loss_fn(params, fi, fj, target):
+            ei = model.apply(params, fi)
+            ej = model.apply(params, fj)
+            pred = jnp.sum(ei * ej, axis=-1)
+            return jnp.mean((pred - target) ** 2)
+
+        def step(params, opt_state, fi, fj, target):
+            loss, grads = jax.value_and_grad(loss_fn)(params, fi, fj, target)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        if self.mesh is None:
+            return jax.jit(step)
+        # Data-parallel: batch axes sharded over dp, params replicated.
+        # jit + shardings → XLA adds the psum over per-device gradients.
+        repl = NamedSharding(self.mesh, P())
+        batch = NamedSharding(self.mesh, P("dp"))
+        return jax.jit(
+            step,
+            in_shardings=(repl, repl, batch, batch, batch),
+            out_shardings=(repl, repl, repl),
+        )
+
+    def sample_batch(self, batch_size: int, rng: np.random.Generator):
+        """Half random pairs, half positive (nonzero-score) pairs so the
+        mostly-zero score matrix doesn't drown the signal. The positive
+        pool is precomputed in __init__ — sampling is O(batch)."""
+        n_pos = batch_size // 2
+        i_rand = rng.integers(0, self.n, size=batch_size - n_pos)
+        j_rand = rng.integers(0, self.n, size=batch_size - n_pos)
+        if len(self._pos_i):
+            sel = rng.integers(0, len(self._pos_i), size=n_pos)
+            pos_rows, pos_cols = self._pos_i[sel], self._pos_j[sel]
+        else:
+            pos_rows = rng.integers(0, self.n, size=n_pos)
+            pos_cols = rng.integers(0, self.n, size=n_pos)
+        i = np.concatenate([i_rand, pos_rows])
+        j = np.concatenate([j_rand, pos_cols])
+        return i, j, self._scores[i, j].astype(np.float32)
+
+    def train(self, steps: int = 200, batch_size: int = 1024, seed: int = 0):
+        """Run optimizer steps; returns the per-step loss history."""
+        rng = np.random.default_rng(seed)
+        losses = []
+        for _ in range(steps):
+            i, j, target = self.sample_batch(batch_size, rng)
+            fi = jnp.asarray(self.features[i])
+            fj = jnp.asarray(self.features[j])
+            params, opt_state, loss = self._train_step(
+                self.state.params, self.state.opt_state, fi, fj,
+                jnp.asarray(target),
+            )
+            self.state = TrainState(params, opt_state, self.state.step + 1)
+            losses.append(float(loss))
+        return losses
+
+    # -- inference ---------------------------------------------------------
+
+    def embeddings(self, features: np.ndarray | None = None) -> np.ndarray:
+        f = self.features if features is None else features
+        return np.asarray(
+            self.model.apply(self.state.params, jnp.asarray(f, jnp.float32))
+        )
+
+    def predict_pairs(self, i: Sequence[int], j: Sequence[int]) -> np.ndarray:
+        e = self.embeddings()
+        return np.sum(e[np.asarray(i)] * e[np.asarray(j)], axis=-1)
+
+    def topk(self, source_index: int, k: int = 10) -> list[tuple[int, float]]:
+        e = self.embeddings()
+        sims = e @ e[source_index]
+        sims[source_index] = -np.inf
+        order = np.argsort(-sims)[:k]
+        return [(int(t), float(sims[t])) for t in order]
+
+    def exact_scores(self) -> np.ndarray:
+        """The supervision targets (exact rowsum-variant PathSim)."""
+        return self._scores

@@ -1,0 +1,115 @@
+"""2-D block-tiled all-pairs scoring over a (dp, tp) device mesh.
+
+For the million-author regime (BASELINE.json config 5) a 1-D row sharding
+still makes every device hold a full [n_loc, N] strip of the score
+matrix; 2-D tiling shards BOTH axes: device (i, j) owns the
+[N/dp, N/tp] tile  S[i·N/dp:, j·N/tp:] = 2·(C_i C_jᵀ) / (d_i ⊕ d_j),
+so per-device memory falls as 1/(dp·tp) and the output sharding matches
+the mesh exactly (XLA keeps it resident, no gather).
+
+Communication: one ``psum`` over ``dp`` for the column totals that feed
+row sums — the C blocks arrive pre-sharded (rows over dp for the left
+operand, rows over tp for the right), so the big product needs NO
+collectives at all. The distributed top-k reduces each device's tile
+locally, then ``all_gather``s only [n_loc, k] candidates over ``tp`` —
+O(N·k/dp) traffic instead of O(N²/dp).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import pad_to_multiple
+
+
+def place_2d(c: np.ndarray, rowsums: np.ndarray, mesh: Mesh,
+             axes: tuple[str, str] = ("dp", "tp")):
+    """Pad and place the half-chain factor twice: row-sharded over dp
+    (left operand) and over tp (right operand), plus the rowsum vector
+    sharded to match. Padding rows are zero → rowsum 0 → score 0."""
+    dp, tp = axes
+    n = c.shape[0]
+    n_pad = pad_to_multiple(n, int(np.lcm(mesh.shape[dp], mesh.shape[tp])))
+    if n_pad != n:
+        c = np.pad(c, ((0, n_pad - n), (0, 0)))
+        rowsums = np.pad(rowsums, (0, n_pad - n))
+    c_row = jax.device_put(c, NamedSharding(mesh, P(dp, None)))
+    c_col = jax.device_put(c, NamedSharding(mesh, P(tp, None)))
+    d_row = jax.device_put(rowsums, NamedSharding(mesh, P(dp)))
+    d_col = jax.device_put(rowsums, NamedSharding(mesh, P(tp)))
+    return c_row, c_col, d_row, d_col
+
+
+def _score_tile(ci, cj, di, dj):
+    """One score tile: 2·(C_i C_jᵀ) / (d_i ⊕ d_j), zero where the
+    denominator is zero. Shared by both shard_map bodies so their
+    numerics can never drift apart."""
+    with jax.default_matmul_precision("highest"):
+        m = jnp.matmul(ci, cj.T)
+    denom = di[:, None] + dj[None, :]
+    return jnp.where(denom > 0, 2.0 * m / jnp.where(denom > 0, denom, 1.0), 0.0)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "axes"))
+def tiled_scores_2d(c_row, c_col, d_row, d_col, mesh: Mesh,
+                    axes: tuple[str, str] = ("dp", "tp")):
+    """All-pairs scores, output sharded (dp, tp) over the mesh."""
+    dp, tp = axes
+
+    run = functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(dp, None), P(tp, None), P(dp), P(tp)),
+        out_specs=P(dp, tp),
+    )(_score_tile)
+
+    return run(c_row, c_col, d_row, d_col)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "axes", "k", "n_true"))
+def tiled_topk_2d(c_row, c_col, d_row, d_col, mesh: Mesh, k: int,
+                  n_true: int, axes: tuple[str, str] = ("dp", "tp")):
+    """Distributed top-k: local tile top-k, then merge over the tp axis.
+
+    Returns (values [N_pad, k], indices [N_pad, k]) row-sharded over dp.
+    Self-pairs are masked; padding columns (≥ n_true) are masked; real
+    zero-degree targets keep score 0 (oracle semantics).
+    """
+    dp, tp = axes
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(dp, None), P(tp, None), P(dp), P(tp)),
+        out_specs=(P(dp, None), P(dp, None)),
+        # After the all_gather over tp every device in a dp row group holds
+        # identical top-k results; the varying-axis checker can't infer
+        # that replication, so it is asserted here instead.
+        check_vma=False,
+    )
+    def run(ci, cj, di, dj):
+        n_loc_r, _ = ci.shape
+        n_loc_c = cj.shape[0]
+        i = jax.lax.axis_index(dp)
+        j = jax.lax.axis_index(tp)
+        s = _score_tile(ci, cj, di, dj)
+        rows = i * n_loc_r + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        cols = j * n_loc_c + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(cols >= n_true, -jnp.inf, s)  # padding columns
+        s = jnp.where(rows == cols, -jnp.inf, s)    # self-pairs
+        kk = min(k, n_loc_c)
+        loc_v, loc_p = jax.lax.top_k(s, kk)          # [n_loc_r, kk]
+        loc_i = j * n_loc_c + loc_p
+        # gather candidates from every column tile of this row block
+        cand_v = jax.lax.all_gather(loc_v, tp, axis=1, tiled=True)
+        cand_i = jax.lax.all_gather(loc_i, tp, axis=1, tiled=True)
+        top_v, top_p = jax.lax.top_k(cand_v, k)
+        top_i = jnp.take_along_axis(cand_i, top_p, axis=1)
+        return top_v, top_i
+
+    return run(c_row, c_col, d_row, d_col)

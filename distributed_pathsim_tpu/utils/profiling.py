@@ -1,0 +1,57 @@
+"""Profiling: stage wall-clock (reference parity) + device traces (new).
+
+The reference's entire observability is ``timeit.default_timer`` deltas
+written to its log (``DPathSim_APVPA.py:26,37,63,67``). StageTimer keeps
+that capability behind a context manager; ``device_trace`` adds what the
+reference never had — a real ``jax.profiler`` trace (XLA op timeline,
+HBM usage) viewable in TensorBoard/Perfetto.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator
+
+import jax
+
+
+class StageTimer:
+    """Accumulates named stage timings; integrates with RunLogger.metric."""
+
+    def __init__(self, logger=None):
+        self.stages: list[tuple[str, float]] = []
+        self._logger = logger
+
+    @contextlib.contextmanager
+    def stage(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self.stages.append((name, dt))
+            if self._logger is not None:
+                self._logger.metric(event="stage_time", stage=name, seconds=dt)
+
+    def total(self) -> float:
+        return sum(dt for _, dt in self.stages)
+
+    def summary(self) -> dict[str, float]:
+        out: dict[str, float] = {}
+        for name, dt in self.stages:
+            out[name] = out.get(name, 0.0) + dt
+        return out
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str | None) -> Iterator[None]:
+    """jax.profiler trace scope; no-op when log_dir is None."""
+    if log_dir is None:
+        yield
+        return
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,74 @@
+"""Neural PathSim: training converges, sharded step == single-device step."""
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_pathsim_tpu.data.synthetic import synthetic_hin
+from distributed_pathsim_tpu.models.neural import NeuralPathSim
+from distributed_pathsim_tpu.parallel.mesh import make_mesh
+
+
+@pytest.fixture(scope="module")
+def hin():
+    return synthetic_hin(200, 300, 16, seed=5)
+
+
+def test_training_reduces_loss(hin):
+    model = NeuralPathSim(hin, "APVPA", dim=32, hidden=64, lr=3e-3, seed=0)
+    losses = model.train(steps=60, batch_size=256, seed=0)
+    assert losses[-1] < losses[0] * 0.5  # clear convergence
+    e = model.embeddings()
+    assert e.shape == (200, 32)
+
+
+def test_predictions_correlate_with_exact(hin):
+    """Quality gates on the signal that matters: correlation on pairs with
+    nonzero exact score (random pairs are ~all zeros and only measure
+    noise floor) and top-k ranking recall vs the exact backend."""
+    model = NeuralPathSim(hin, "APVPA", dim=32, hidden=64, lr=3e-3, seed=0)
+    model.train(steps=600, batch_size=1024, seed=1)
+
+    exact = model.exact_scores()
+    rng = np.random.default_rng(2)
+    ii, jj = np.nonzero(exact > 0)
+    sel = rng.integers(0, len(ii), size=500)
+    corr = np.corrcoef(
+        model.predict_pairs(ii[sel], jj[sel]), exact[ii[sel], jj[sel]]
+    )[0, 1]
+    assert corr > 0.8, corr
+
+    e = model.embeddings()
+    sims = e @ e.T
+    masked = exact.copy()
+    np.fill_diagonal(masked, -np.inf)
+    np.fill_diagonal(sims, -np.inf)
+    recalls = []
+    for i in range(exact.shape[0]):
+        npos = int((masked[i] > 0).sum())
+        if npos == 0:
+            continue
+        k = min(10, npos)
+        top_exact = set(np.argsort(-masked[i])[:k].tolist())
+        top_pred = set(np.argsort(-sims[i])[:k].tolist())
+        recalls.append(len(top_exact & top_pred) / k)
+    assert np.mean(recalls) > 0.5, np.mean(recalls)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
+def test_sharded_step_matches_single_device(hin):
+    single = NeuralPathSim(hin, "APVPA", dim=16, hidden=32, seed=3)
+    sharded = NeuralPathSim(
+        hin, "APVPA", dim=16, hidden=32, seed=3, mesh=make_mesh(8)
+    )
+    l1 = single.train(steps=5, batch_size=256, seed=7)
+    l2 = sharded.train(steps=5, batch_size=256, seed=7)
+    np.testing.assert_allclose(l1, l2, rtol=1e-4)
+    np.testing.assert_allclose(
+        single.embeddings(), sharded.embeddings(), atol=1e-5
+    )
+
+
+def test_asymmetric_rejected(hin):
+    with pytest.raises(ValueError, match="symmetric"):
+        NeuralPathSim(hin, "APV")

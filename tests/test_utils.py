@@ -1,0 +1,115 @@
+"""Checkpoint/resume and profiling utilities."""
+
+import numpy as np
+import pytest
+
+from distributed_pathsim_tpu.backends.base import create_backend
+from distributed_pathsim_tpu.data.synthetic import synthetic_hin
+from distributed_pathsim_tpu.ops.metapath import compile_metapath
+from distributed_pathsim_tpu.utils.checkpoint import CheckpointManager
+from distributed_pathsim_tpu.utils.profiling import StageTimer
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    ckpt = CheckpointManager(str(tmp_path / "run"))
+    assert not ckpt.is_done("tile_0")
+    ckpt.save_unit("tile_0", vals=np.arange(6).reshape(2, 3))
+    assert ckpt.is_done("tile_0")
+    # new manager over the same directory sees the completed unit
+    ckpt2 = CheckpointManager(str(tmp_path / "run"))
+    assert ckpt2.is_done("tile_0")
+    np.testing.assert_array_equal(
+        ckpt2.load_unit("tile_0")["vals"], np.arange(6).reshape(2, 3)
+    )
+    assert ckpt2.done_keys() == ["tile_0"]
+
+
+def test_sparse_topk_resume(tmp_path):
+    hin = synthetic_hin(300, 500, 25, seed=3)
+    mp = compile_metapath("APVPA", hin.schema)
+    b = create_backend("jax-sparse", hin, mp, tile_rows=64)
+    ckdir = str(tmp_path / "ck")
+    v1, i1 = b.topk_scores(k=4, checkpoint_dir=ckdir)
+    # fresh backend resumes entirely from checkpoint: results identical,
+    # and NO tile is ever computed (m_tile raising proves the resume path)
+    b2 = create_backend("jax-sparse", hin, mp, tile_rows=64)
+    b2.tiled.m_tile = lambda *a: (_ for _ in ()).throw(
+        AssertionError("tile recomputed despite complete checkpoint")
+    )
+    v2, i2 = b2.topk_scores(k=4, checkpoint_dir=ckdir)
+    np.testing.assert_array_equal(v1, v2)
+    np.testing.assert_array_equal(i1, i2)
+    # and matches a no-checkpoint run
+    v3, i3 = create_backend("jax-sparse", hin, mp, tile_rows=64).topk_scores(k=4)
+    np.testing.assert_array_equal(v1, v3)
+
+
+def test_partial_checkpoint_resume(tmp_path):
+    """Simulate a crash after a few tiles: precompute some units, then a
+    full run must reuse them and fill in the rest."""
+    hin = synthetic_hin(200, 300, 16, seed=4)
+    mp = compile_metapath("APVPA", hin.schema)
+    ckdir = str(tmp_path / "ck2")
+    full_v, full_i = create_backend(
+        "jax-sparse", hin, mp, tile_rows=64
+    ).topk_scores(k=3)
+
+    # "crashed" run: only tile 0 completed
+    ckpt = CheckpointManager(ckdir)
+    ckpt.save_unit("topk3_rowtile_0", vals=full_v[:64], idxs=full_i[:64])
+    v, i = create_backend("jax-sparse", hin, mp, tile_rows=64).topk_scores(
+        k=3, checkpoint_dir=ckdir
+    )
+    np.testing.assert_array_equal(v, full_v)
+    np.testing.assert_array_equal(i, full_i)
+
+
+def test_stage_timer():
+    class FakeLogger:
+        events = []
+
+        def metric(self, **kw):
+            self.events.append(kw)
+
+    logger = FakeLogger()
+    t = StageTimer(logger)
+    with t.stage("encode"):
+        pass
+    with t.stage("chain"):
+        pass
+    with t.stage("chain"):
+        pass
+    assert [s for s, _ in t.stages] == ["encode", "chain", "chain"]
+    assert set(t.summary()) == {"encode", "chain"}
+    assert t.total() >= 0
+    assert len(logger.events) == 3
+    assert logger.events[0]["stage"] == "encode"
+
+
+def test_device_trace_noop():
+    from distributed_pathsim_tpu.utils.profiling import device_trace
+
+    with device_trace(None):
+        pass  # must not start the profiler
+
+
+def test_checkpoint_rejects_different_run(tmp_path):
+    from distributed_pathsim_tpu.data.synthetic import synthetic_hin as syn
+
+    hin_a = syn(200, 300, 16, seed=4)
+    hin_b = syn(200, 300, 16, seed=99)  # same shape, different graph
+    mp_a = compile_metapath("APVPA", hin_a.schema)
+    mp_b = compile_metapath("APVPA", hin_b.schema)
+    ckdir = str(tmp_path / "ck3")
+    create_backend("jax-sparse", hin_a, mp_a, tile_rows=64).topk_scores(
+        k=3, checkpoint_dir=ckdir
+    )
+    with pytest.raises(ValueError, match="different run"):
+        create_backend("jax-sparse", hin_b, mp_b, tile_rows=64).topk_scores(
+            k=3, checkpoint_dir=ckdir
+        )
+    # different tile_rows and k also rejected
+    with pytest.raises(ValueError, match="different run"):
+        create_backend("jax-sparse", hin_a, mp_a, tile_rows=32).topk_scores(
+            k=3, checkpoint_dir=ckdir
+        )
