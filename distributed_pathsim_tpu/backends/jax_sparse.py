@@ -95,11 +95,14 @@ class JaxSparseBackend(PathSimBackend):
     def _run_config(self, k: int) -> dict:
         """Checkpoint identity: graph fingerprint + tiling + k. A reused
         directory from a different run must fail, not resume."""
+        import hashlib
+
         c = self._c
-        digest = int(
-            (c.rows * 2654435761 + c.cols * 40503 + c.weights.astype(np.int64))
-            .sum() % (1 << 53)
-        )
+        h = hashlib.sha256()
+        h.update(np.ascontiguousarray(c.rows, dtype=np.int64).tobytes())
+        h.update(np.ascontiguousarray(c.cols, dtype=np.int64).tobytes())
+        h.update(np.ascontiguousarray(c.weights, dtype=np.float64).tobytes())
+        digest = h.hexdigest()[:16]
         return {
             "n": int(self.n),
             "v": int(c.shape[1]),

@@ -84,16 +84,24 @@ class NeuralPathSim:
         for b in blocks[1:]:
             c = c @ b
         self.n, self.v = c.shape
-        # exact targets (rowsum-variant PathSim) from the oracle chain
-        from ..ops.pathsim import score_matrix
-
-        c64 = c.astype(np.float64)
-        self._scores = score_matrix(c64 @ c64.T, variant="rowsum", xp=np)
-        # nonzero pairs, precomputed once: positive-sample pool for training
-        self._pos_i, self._pos_j = np.nonzero(self._scores)
+        # Exact targets (rowsum-variant PathSim) are computed ON DEMAND per
+        # batch from the half-chain factor C — never the dense N×N matrix,
+        # so the trainer scales to graphs where exact all-pairs can't exist.
+        self._c64 = c.astype(np.float64)
+        self._d = self._c64 @ self._c64.sum(axis=0)  # row sums of M = C·Cᵀ
+        # Positive-sample pool without touching M: a pair sharing any
+        # contraction column (venue) has M[i,j] > 0, so sample a nonzero of
+        # C then a co-occupant of its column. CSC-style column lists make
+        # each draw O(1).
+        nz_i, nz_v = np.nonzero(c)
+        order = np.argsort(nz_v, kind="stable")
+        self._nz_rows, nz_cols = nz_i[order], nz_v[order]
+        self._col_ptr = np.searchsorted(nz_cols, np.arange(self.v + 1))
         # features: degree-normalized C rows (unit L2 where nonzero)
         norms = np.linalg.norm(c, axis=1, keepdims=True)
         self.features = (c / np.where(norms > 0, norms, 1)).astype(np.float32)
+        self._scores_cache: np.ndarray | None = None
+        self._emb_cache: np.ndarray | None = None
 
         self.model = TwoTower(hidden=hidden, dim=dim)
         rng = jax.random.PRNGKey(seed)
@@ -130,27 +138,47 @@ class NeuralPathSim:
             out_shardings=(repl, repl, repl),
         )
 
+    def pair_scores(self, i: np.ndarray, j: np.ndarray) -> np.ndarray:
+        """Exact rowsum-variant PathSim for arbitrary pairs, O(batch·V):
+        2·(C[i]·C[j]) / (d[i]+d[j]) — no N×N matrix involved."""
+        i = np.asarray(i)
+        j = np.asarray(j)
+        num = 2.0 * np.einsum("bv,bv->b", self._c64[i], self._c64[j])
+        denom = self._d[i] + self._d[j]
+        return np.where(denom > 0, num / np.where(denom > 0, denom, 1), 0.0)
+
     def sample_batch(self, batch_size: int, rng: np.random.Generator):
         """Half random pairs, half positive (nonzero-score) pairs so the
-        mostly-zero score matrix doesn't drown the signal. The positive
-        pool is precomputed in __init__ — sampling is O(batch)."""
+        mostly-zero score distribution doesn't drown the signal. Positives
+        come from shared contraction columns (same venue ⇒ M[i,j] > 0);
+        targets are computed on demand — everything is O(batch·V)."""
         n_pos = batch_size // 2
         i_rand = rng.integers(0, self.n, size=batch_size - n_pos)
         j_rand = rng.integers(0, self.n, size=batch_size - n_pos)
-        if len(self._pos_i):
-            sel = rng.integers(0, len(self._pos_i), size=n_pos)
-            pos_rows, pos_cols = self._pos_i[sel], self._pos_j[sel]
+        nnz = len(self._nz_rows)
+        if nnz:
+            sel = rng.integers(0, nnz, size=n_pos)
+            pos_rows = self._nz_rows[sel]
+            # a random co-occupant of the same column
+            v = np.searchsorted(self._col_ptr, sel, side="right") - 1
+            lo, hi = self._col_ptr[v], self._col_ptr[v + 1]
+            pos_cols = self._nz_rows[
+                lo + rng.integers(0, np.maximum(hi - lo, 1))
+            ]
         else:
             pos_rows = rng.integers(0, self.n, size=n_pos)
             pos_cols = rng.integers(0, self.n, size=n_pos)
         i = np.concatenate([i_rand, pos_rows])
         j = np.concatenate([j_rand, pos_cols])
-        return i, j, self._scores[i, j].astype(np.float32)
+        return i, j, self.pair_scores(i, j).astype(np.float32)
 
     def train(self, steps: int = 200, batch_size: int = 1024, seed: int = 0):
         """Run optimizer steps; returns the per-step loss history."""
         rng = np.random.default_rng(seed)
         losses = []
+        # invalidate up front: params change from the first step, and an
+        # exception mid-loop must not leave a stale cache behind
+        self._emb_cache = None
         for _ in range(steps):
             i, j, target = self.sample_batch(batch_size, rng)
             fi = jnp.asarray(self.features[i])
@@ -166,14 +194,36 @@ class NeuralPathSim:
     # -- inference ---------------------------------------------------------
 
     def embeddings(self, features: np.ndarray | None = None) -> np.ndarray:
-        f = self.features if features is None else features
-        return np.asarray(
-            self.model.apply(self.state.params, jnp.asarray(f, jnp.float32))
-        )
+        """Embed the given features, or the full corpus (cached — training
+        invalidates the cache, so repeated queries don't re-run the MLP)."""
+        if features is not None:
+            return np.asarray(
+                self.model.apply(
+                    self.state.params, jnp.asarray(features, jnp.float32)
+                )
+            )
+        if self._emb_cache is None:
+            emb = np.asarray(
+                self.model.apply(
+                    self.state.params, jnp.asarray(self.features, jnp.float32)
+                )
+            )
+            # read-only so a caller's in-place edit can't corrupt later
+            # predict_pairs/topk results through the shared cache
+            emb.flags.writeable = False
+            self._emb_cache = emb
+        return self._emb_cache
 
     def predict_pairs(self, i: Sequence[int], j: Sequence[int]) -> np.ndarray:
-        e = self.embeddings()
-        return np.sum(e[np.asarray(i)] * e[np.asarray(j)], axis=-1)
+        i = np.asarray(i)
+        j = np.asarray(j)
+        if self._emb_cache is not None:
+            e = self._emb_cache
+            return np.sum(e[i] * e[j], axis=-1)
+        # no corpus cache yet: embed only the requested rows
+        ei = self.embeddings(self.features[i])
+        ej = self.embeddings(self.features[j])
+        return np.sum(ei * ej, axis=-1)
 
     def topk(self, source_index: int, k: int = 10) -> list[tuple[int, float]]:
         e = self.embeddings()
@@ -182,6 +232,22 @@ class NeuralPathSim:
         order = np.argsort(-sims)[:k]
         return [(int(t), float(sims[t])) for t in order]
 
+    # Refuse to densify the exact score matrix beyond this many entries.
+    _DENSE_SCORES_MAX_ENTRIES = 1 << 26
+
     def exact_scores(self) -> np.ndarray:
-        """The supervision targets (exact rowsum-variant PathSim)."""
-        return self._scores
+        """The dense supervision-target matrix (exact rowsum-variant
+        PathSim), for validation on small graphs. Guarded: training never
+        needs it — use :meth:`pair_scores` for O(batch) exact targets."""
+        if self._scores_cache is None:
+            if self.n * self.n > self._DENSE_SCORES_MAX_ENTRIES:
+                raise MemoryError(
+                    f"dense scores would be {self.n}x{self.n}; "
+                    "use pair_scores(i, j)"
+                )
+            from ..ops.pathsim import score_matrix
+
+            self._scores_cache = score_matrix(
+                self._c64 @ self._c64.T, variant="rowsum", xp=np
+            )
+        return self._scores_cache

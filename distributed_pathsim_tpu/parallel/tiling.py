@@ -108,8 +108,16 @@ def tiled_topk_2d(c_row, c_col, d_row, d_col, mesh: Mesh, k: int,
         # gather candidates from every column tile of this row block
         cand_v = jax.lax.all_gather(loc_v, tp, axis=1, tiled=True)
         cand_i = jax.lax.all_gather(loc_i, tp, axis=1, tiled=True)
-        top_v, top_p = jax.lax.top_k(cand_v, k)
+        # k can exceed the merged candidate width (tp·kk) on tiny graphs;
+        # take what exists and pad to k with -inf, matching the 1-D
+        # streaming path's k > N behavior.
+        k_avail = min(k, kk * mesh.shape[tp])
+        top_v, top_p = jax.lax.top_k(cand_v, k_avail)
         top_i = jnp.take_along_axis(cand_i, top_p, axis=1)
+        if k_avail < k:
+            pad = ((0, 0), (0, k - k_avail))
+            top_v = jnp.pad(top_v, pad, constant_values=-jnp.inf)
+            top_i = jnp.pad(top_i, pad)
         return top_v, top_i
 
     return run(c_row, c_col, d_row, d_col)

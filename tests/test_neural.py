@@ -72,3 +72,37 @@ def test_sharded_step_matches_single_device(hin):
 def test_asymmetric_rejected(hin):
     with pytest.raises(ValueError, match="symmetric"):
         NeuralPathSim(hin, "APV")
+
+
+def test_pair_scores_match_dense_oracle(hin):
+    """On-demand exact targets == the dense score matrix, pairwise."""
+    model = NeuralPathSim(hin, "APVPA", dim=8, hidden=16, seed=0)
+    exact = model.exact_scores()
+    rng = np.random.default_rng(3)
+    i = rng.integers(0, 200, size=300)
+    j = rng.integers(0, 200, size=300)
+    np.testing.assert_allclose(model.pair_scores(i, j), exact[i, j], atol=1e-12)
+
+
+def test_exact_scores_guarded(hin, monkeypatch):
+    model = NeuralPathSim(hin, "APVPA", dim=8, hidden=16, seed=0)
+    monkeypatch.setattr(NeuralPathSim, "_DENSE_SCORES_MAX_ENTRIES", 100)
+    with pytest.raises(MemoryError, match="pair_scores"):
+        model.exact_scores()
+
+
+def test_embedding_cache_invalidated_by_training(hin):
+    model = NeuralPathSim(hin, "APVPA", dim=8, hidden=16, seed=0)
+    e0 = model.embeddings()
+    assert model.embeddings() is e0  # cached, not recomputed
+    model.train(steps=1, batch_size=64, seed=0)
+    e1 = model.embeddings()
+    assert e1 is not e0
+    assert not np.allclose(e0, e1)
+
+
+def test_embedding_cache_is_read_only(hin):
+    model = NeuralPathSim(hin, "APVPA", dim=8, hidden=16, seed=0)
+    e = model.embeddings()
+    with pytest.raises(ValueError):
+        e[0, 0] = 99.0

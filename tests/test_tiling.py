@@ -53,3 +53,28 @@ def test_tiled_topk_matches_oracle(setup):
         expect = np.sort(scores[i])[::-1][:5]
         np.testing.assert_allclose(vals[i], expect, atol=1e-7)
         np.testing.assert_allclose(scores[i][idxs[i]], expect, atol=1e-7)
+
+
+def test_tiled_topk_k_exceeds_nodes():
+    """k larger than the (padded) node count must pad with -inf instead of
+    crashing inside the merged top_k — matching the 1-D streaming path."""
+    from distributed_pathsim_tpu.data.synthetic import synthetic_hin
+
+    hin = synthetic_hin(6, 12, 3, seed=11)
+    mp = compile_metapath("APVPA", hin.schema)
+    oracle = create_backend("numpy", hin, mp)
+    ap = hin.block("author_of").to_dense(np.float32)
+    pv = hin.block("submit_at").to_dense(np.float32)
+    c = (ap @ pv).astype(np.float32)
+    d = (c @ c.sum(axis=0)).astype(np.float32)
+    mesh = make_mesh_2d((2, 2))
+    args = place_2d(c, d, mesh)
+    vals, idxs = tiled_topk_2d(*args, mesh=mesh, k=16, n_true=6)
+    vals = np.asarray(vals, dtype=np.float64)[:6]
+    assert vals.shape == (6, 16)
+    scores = oracle.all_pairs_scores().copy()
+    np.fill_diagonal(scores, -np.inf)
+    for i in range(6):
+        expect = np.sort(scores[i])[::-1][:5]
+        np.testing.assert_allclose(vals[i, :5], expect, atol=1e-7)
+    assert np.all(np.isneginf(vals[:, 8:]))  # beyond N_pad: -inf padding

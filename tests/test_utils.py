@@ -113,3 +113,23 @@ def test_checkpoint_rejects_different_run(tmp_path):
         create_backend("jax-sparse", hin_a, mp_a, tile_rows=32).topk_scores(
             k=3, checkpoint_dir=ckdir
         )
+
+
+def test_checkpoint_digest_sensitive_to_structure(tmp_path):
+    """Graphs whose row/col/weight marginal sums coincide must still get
+    distinct fingerprints (a linear-sum digest would collide on e.g.
+    swapping which authors wrote which papers)."""
+    from distributed_pathsim_tpu.ops import sparse as sp
+
+    hin = synthetic_hin(64, 96, 8, seed=0)
+    mp = compile_metapath("APVPA", hin.schema)
+    b = create_backend("jax-sparse", hin, mp, tile_rows=32)
+    mk = lambda rows, cols: sp.COOMatrix(
+        rows=np.array(rows), cols=np.array(cols),
+        weights=np.ones(len(rows)), shape=(2, 2),
+    )
+    b._c = mk([0, 1], [1, 0])
+    d1 = b._run_config(3)["digest"]
+    b._c = mk([0, 1], [0, 1])  # same marginal sums, different structure
+    d2 = b._run_config(3)["digest"]
+    assert d1 != d2
