@@ -1,0 +1,149 @@
+"""Multi-host execution: DCN-aware meshes and host-local data placement.
+
+The reference delegates every cross-machine concern to Spark's JVM
+shuffle (netty RPC + block manager, invoked implicitly by
+``gf.find(...).count()`` — ``DPathSim_APVPA.py:72-86``; SURVEY.md §5).
+The TPU-native counterpart is multi-host SPMD: one program, a global
+device mesh spanning hosts, XLA routing collectives over ICI inside a
+slice and DCN between slices. This module provides the three pieces a
+multi-host run needs — nothing here talks to a transport:
+
+1. :func:`initialize_multihost` — ``jax.distributed`` bootstrap
+   (coordinator rendezvous); an explicit no-op for single-process runs so
+   the same driver script works on a laptop and a pod.
+2. :func:`make_hybrid_mesh` — a ``(dp, tp)`` mesh whose ``dp`` (row)
+   axis spans hosts over DCN while ``tp`` stays inside a slice on ICI.
+   This matches the chain's communication profile: the only cross-``dp``
+   collective is the column-total ``psum`` (an O(V) vector — cheap over
+   DCN), while the heavy ``all_gather``/``ppermute`` of C row-blocks and
+   the top-k candidate merge ride ``tp``'s ICI links.
+3. :func:`host_row_range` / :func:`distributed_first_block` — each host
+   loads ONLY its own rows of the first adjacency block and the global
+   sharded array is assembled via
+   ``jax.make_array_from_process_local_data``; no host ever materializes
+   the full matrix.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import pad_to_multiple
+
+# Env vars that signal a jax.distributed cluster rendezvous is expected.
+# Deliberately ONLY explicit coordinator addresses: markers like
+# TPU_WORKER_HOSTNAMES or SLURM_JOB_ID are also set on single-host
+# workers, where calling jax.distributed.initialize() after backend
+# init would raise.
+_CLUSTER_ENV_VARS = (
+    "JAX_COORDINATOR_ADDRESS",
+    "COORDINATOR_ADDRESS",
+)
+
+
+def initialize_multihost(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+    **kwargs,
+) -> bool:
+    """Bootstrap ``jax.distributed`` when running multi-process.
+
+    Explicit arguments always initialize. With no arguments, initializes
+    only if a known cluster environment is detected — otherwise this is a
+    no-op so single-process runs need no special casing. Returns True iff
+    the process is part of a multi-process job after the call.
+    """
+    if jax.process_count() > 1:
+        return True  # already initialized by the launcher
+    explicit = coordinator_address is not None
+    detected = any(v in os.environ for v in _CLUSTER_ENV_VARS)
+    if explicit or detected:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            **kwargs,
+        )
+    return jax.process_count() > 1
+
+
+def make_hybrid_mesh(
+    tp: int = 1, axes: tuple[str, str] = ("dp", "tp"), devices=None
+) -> Mesh:
+    """Build a ``(dp, tp)`` mesh with ``dp`` spanning hosts over DCN.
+
+    ``tp`` devices per tile-column stay within one host's slice (ICI);
+    the remaining device factor — local dp × number of hosts — forms the
+    row axis, hosts outermost, so each host's processes own contiguous
+    row ranges (see :func:`host_row_range`). Single-process: falls back
+    to an ICI-optimised local mesh of the same shape.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n_local = len([d for d in devices if d.process_index == jax.process_index()])
+    n_hosts = jax.process_count()
+    if n_local % tp != 0:
+        raise ValueError(
+            f"tp={tp} must divide the per-host device count {n_local}"
+        )
+    if n_hosts > 1:
+        dev_mesh = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=(n_local // tp, tp),
+            dcn_mesh_shape=(n_hosts, 1),
+            devices=devices,
+        )
+    else:
+        dev_mesh = mesh_utils.create_device_mesh(
+            (n_local // tp, tp), devices=devices
+        )
+    return Mesh(dev_mesh, axes)
+
+
+def host_row_range(n_rows: int, mesh: Mesh, axis: str = "dp") -> tuple[int, int]:
+    """The contiguous [start, stop) slice of the (padded) global row axis
+    owned by THIS process under ``axis``-sharding on ``mesh``.
+
+    Row ownership follows the mesh's device order: with hosts outermost
+    on ``dp`` (as :func:`make_hybrid_mesh` builds it), process p owns
+    rows [p·n_pad/P, (p+1)·n_pad/P). The stop of the last host covers
+    the padding; callers zero-fill rows beyond ``n_rows``.
+    """
+    n_pad = pad_to_multiple(n_rows, mesh.shape[axis])
+    per_host = n_pad // jax.process_count()
+    start = jax.process_index() * per_host
+    return start, start + per_host
+
+
+def distributed_first_block(
+    load_rows: Callable[[int, int], np.ndarray],
+    n_rows: int,
+    n_cols: int,
+    mesh: Mesh,
+    axis: str = "dp",
+    dtype=np.float32,
+) -> jax.Array:
+    """Assemble the row-sharded first chain block without any host ever
+    holding it whole.
+
+    ``load_rows(start, stop)`` returns this host's rows (rows past
+    ``n_rows`` — padding — must not be requested from it; they are
+    zero-filled here). The result is a global jax.Array sharded
+    ``P(axis, None)`` over ``mesh``, ready for
+    :func:`..parallel.sharded.sharded_chain_outputs`.
+    """
+    n_pad = pad_to_multiple(n_rows, mesh.shape[axis])
+    start, stop = host_row_range(n_rows, mesh, axis)
+    real_stop = min(stop, n_rows)
+    local = np.zeros((stop - start, n_cols), dtype=dtype)
+    if real_stop > start:
+        local[: real_stop - start] = load_rows(start, real_stop)
+    sharding = NamedSharding(mesh, P(axis, None))
+    return jax.make_array_from_process_local_data(
+        sharding, local, global_shape=(n_pad, n_cols)
+    )

@@ -77,4 +77,6 @@ def test_tiled_topk_k_exceeds_nodes():
     for i in range(6):
         expect = np.sort(scores[i])[::-1][:5]
         np.testing.assert_allclose(vals[i, :5], expect, atol=1e-7)
-    assert np.all(np.isneginf(vals[:, 8:]))  # beyond N_pad: -inf padding
+    # 6 nodes pad to lcm(2,2) → N_pad=6, so k_avail=6: column 5 is the
+    # masked self-pair, columns 6+ are the explicit -inf k padding
+    assert np.all(np.isneginf(vals[:, 5:]))
