@@ -83,6 +83,14 @@ class NeuralPathSim:
         c = blocks[0]
         for b in blocks[1:]:
             c = c @ b
+        self._setup_from_c(c, dim=dim, hidden=hidden, lr=lr, seed=seed)
+
+    def _setup_from_c(
+        self, c: np.ndarray, dim: int, hidden: int, lr: float, seed: int
+    ) -> None:
+        """Derive all trainer state from the half-chain factor C — shared
+        by the constructor and :meth:`load`."""
+        self._config = {"dim": dim, "hidden": hidden, "lr": lr, "seed": seed}
         self.n, self.v = c.shape
         # Exact targets (rowsum-variant PathSim) are computed ON DEMAND per
         # batch from the half-chain factor C — never the dense N×N matrix,
@@ -251,3 +259,82 @@ class NeuralPathSim:
                 self._c64 @ self._c64.T, variant="rowsum", xp=np
             )
         return self._scores_cache
+
+    # -- persistence -------------------------------------------------------
+
+    def save(self, path: str) -> None:
+        """Persist the trained model to one ``.npz`` file: tower params,
+        optimizer state, step counter, hyperparameters, metapath name, and
+        the half-chain factor C (from which every derived structure —
+        features, row sums, positive pool — is rebuilt on load). Written
+        atomically so a crash mid-save can't corrupt an earlier snapshot.
+
+        The reference has no model state at all (SURVEY.md §5,
+        checkpoint row); this is the checkpoint/resume capability for the
+        framework's learned-index model family.
+        """
+        import json
+        import os
+
+        from flax import serialization
+
+        payload = {
+            "c": self._c64.astype(np.float32),
+            "params": np.frombuffer(
+                serialization.to_bytes(self.state.params), dtype=np.uint8
+            ),
+            "opt_state": np.frombuffer(
+                serialization.to_bytes(self.state.opt_state), dtype=np.uint8
+            ),
+            "step": np.int64(self.state.step),
+            "config": np.frombuffer(
+                json.dumps(
+                    {**self._config, "metapath": self.metapath.name}
+                ).encode(),
+                dtype=np.uint8,
+            ),
+        }
+        tmp = f"{path}.tmp{os.getpid()}"
+        with open(tmp, "wb") as f:  # stream: no second in-memory copy of C
+            np.savez_compressed(f, **payload)
+        os.replace(tmp, path)
+
+    @classmethod
+    def load(
+        cls,
+        path: str,
+        hin: EncodedHIN | None = None,
+        mesh: Mesh | None = None,
+    ) -> "NeuralPathSim":
+        """Restore a model saved by :meth:`save`.
+
+        ``hin`` is optional: inference and resumed training only need the
+        stored C factor. Pass it (with the same graph) to re-attach label
+        lookups via ``self.hin``; the metapath is re-compiled against it,
+        otherwise only its name survives the round-trip.
+        """
+        import json
+
+        from flax import serialization
+
+        with np.load(path) as z:
+            c = z["c"]
+            params_bytes = z["params"].tobytes()
+            opt_bytes = z["opt_state"].tobytes()
+            step = int(z["step"])
+            config = json.loads(z["config"].tobytes().decode())
+
+        metapath_name = config.pop("metapath")
+        self = cls.__new__(cls)
+        self.hin = hin
+        self.metapath = (
+            compile_metapath(metapath_name, hin.schema)
+            if hin is not None
+            else MetaPath(name=metapath_name, node_types=(), steps=())
+        )
+        self.mesh = mesh
+        self._setup_from_c(c, **config)
+        params = serialization.from_bytes(self.state.params, params_bytes)
+        opt_state = serialization.from_bytes(self.state.opt_state, opt_bytes)
+        self.state = TrainState(params=params, opt_state=opt_state, step=step)
+        return self

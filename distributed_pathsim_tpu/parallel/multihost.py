@@ -60,17 +60,30 @@ def initialize_multihost(
     no-op so single-process runs need no special casing. Returns True iff
     the process is part of a multi-process job after the call.
     """
-    if jax.process_count() > 1:
-        return True  # already initialized by the launcher
     explicit = coordinator_address is not None
     detected = any(v in os.environ for v in _CLUSTER_ENV_VARS)
-    if explicit or detected:
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes,
-            process_id=process_id,
-            **kwargs,
+    if not (explicit or detected):
+        return jax.process_count() > 1
+    # Order matters: jax.process_count() itself initializes the XLA
+    # backend, after which jax.distributed.initialize() raises — so the
+    # rendezvous decision must come first, guarded only by the (backend-
+    # neutral) initialized check.
+    from jax._src import xla_bridge
+
+    if xla_bridge.backends_are_initialized():
+        if jax.process_count() > 1:
+            return True  # launcher already initialized the cluster
+        raise RuntimeError(
+            "initialize_multihost() must be called before any JAX backend "
+            "use (jax.devices(), computations, device_put, …); move it to "
+            "program start"
         )
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        **kwargs,
+    )
     return jax.process_count() > 1
 
 
@@ -93,10 +106,15 @@ def make_hybrid_mesh(
             f"tp={tp} must divide the per-host device count {n_local}"
         )
     if n_hosts > 1:
+        # process_is_granule: DCN granules are PROCESSES, not TPU slices —
+        # a multi-host single-slice pod (e.g. v4-32, 4 processes) has one
+        # slice but four hosts, and row ownership must follow processes
+        # for host_row_range()'s contiguity guarantee to hold.
         dev_mesh = mesh_utils.create_hybrid_device_mesh(
             mesh_shape=(n_local // tp, tp),
             dcn_mesh_shape=(n_hosts, 1),
             devices=devices,
+            process_is_granule=True,
         )
     else:
         dev_mesh = mesh_utils.create_device_mesh(

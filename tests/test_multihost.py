@@ -86,3 +86,11 @@ def test_hybrid_mesh_runs_2d_tiling(dblp_small_hin):
     s = np.asarray(tiled_scores_2d(*args, mesh=mesh), dtype=np.float64)
     n = c.shape[0]
     np.testing.assert_allclose(s[:n, :n], oracle.all_pairs_scores(), atol=1e-7)
+
+
+def test_initialize_explicit_after_backend_init_raises(monkeypatch):
+    """With backends already up (conftest), an explicit rendezvous request
+    must fail with OUR actionable error, not jax's late-init RuntimeError
+    deep inside distributed.initialize."""
+    with pytest.raises(RuntimeError, match="before any JAX backend"):
+        initialize_multihost(coordinator_address="127.0.0.1:9999")

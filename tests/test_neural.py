@@ -106,3 +106,33 @@ def test_embedding_cache_is_read_only(hin):
     e = model.embeddings()
     with pytest.raises(ValueError):
         e[0, 0] = 99.0
+
+
+def test_save_load_roundtrip(hin, tmp_path):
+    model = NeuralPathSim(hin, "APVPA", dim=16, hidden=32, lr=3e-3, seed=0)
+    model.train(steps=20, batch_size=256, seed=0)
+    p = str(tmp_path / "model.npz")
+    model.save(p)
+
+    # inference-only restore: no HIN needed
+    loaded = NeuralPathSim.load(p)
+    np.testing.assert_allclose(loaded.embeddings(), model.embeddings(), atol=1e-6)
+    assert loaded.state.step == model.state.step
+    assert loaded.topk(3, k=5) == model.topk(3, k=5)
+
+    # restore with HIN re-attaches the compiled metapath
+    loaded2 = NeuralPathSim.load(p, hin=hin)
+    assert loaded2.metapath.is_symmetric
+
+
+def test_save_load_resume_training(hin, tmp_path):
+    """A loaded model must continue training exactly like the original
+    (same optimizer state, same step stream)."""
+    a = NeuralPathSim(hin, "APVPA", dim=16, hidden=32, lr=3e-3, seed=0)
+    a.train(steps=10, batch_size=256, seed=0)
+    p = str(tmp_path / "model.npz")
+    a.save(p)
+    b = NeuralPathSim.load(p)
+    la = a.train(steps=5, batch_size=256, seed=42)
+    lb = b.train(steps=5, batch_size=256, seed=42)
+    np.testing.assert_allclose(la, lb, rtol=1e-5)
