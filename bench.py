@@ -11,8 +11,11 @@ Zipf venue popularity like the real data) and measure the full product:
 PathSim scores for EVERY ordered author pair (reference row-sum
 semantics) reduced to a per-author top-10 ranking, computed by the
 pallas fused matmul+normalize+topk kernel on TPU — the score matrix
-never materializes in HBM. Timed per repetition: half-chain GEMMs, row
-sums, all-pairs fused scoring, and fetch of the [N,10] rankings to host.
+never materializes in HBM. The half-chain factor C is host-folded COO
+shipped as indices and scatter-assembled on device (O(nnz), no dense
+N×P block ever exists). Timed per repetition: device scatter-assembly
+of C, row sums, all-pairs fused scoring, and fetch of the [N,10]
+rankings to host.
 Correctness of this exact path is pinned against the f64 oracle in
 tests/test_pallas.py and validated here on a spot row each run.
 """

@@ -34,16 +34,7 @@ class JaxSparseBackend(PathSimBackend):
         super().__init__(hin, metapath, **options)
         if not metapath.is_symmetric:
             raise ValueError("jax-sparse requires a symmetric metapath")
-        coo_blocks = []
-        for st in metapath.half():
-            c = sp.coo_from_block(hin.block(st.relationship))
-            if st.reverse:
-                c = sp.COOMatrix(
-                    rows=c.cols, cols=c.rows, weights=c.weights,
-                    shape=(c.shape[1], c.shape[0]),
-                )
-            coo_blocks.append(c)
-        self._c = sp.fold_half_chain(coo_blocks)
+        self._c = sp.half_chain_coo(hin, metapath)
         self.n = self._c.shape[0]
         self.tiled = sp.TiledHalfChain(
             self._c, tile_rows=min(tile_rows, max(self.n, 8)), dtype=dtype
@@ -111,6 +102,10 @@ class JaxSparseBackend(PathSimBackend):
             "tile_rows": int(self.tiled.tile_rows),
             "k": int(k),
             "metapath": self.metapath.name,
+            # Bump whenever the numeric regime of saved units changes —
+            # v2 = on-device f32 score division + lax.top_k tie-breaks.
+            # Prevents resuming tiles written under different math.
+            "format": "stream-topk-v2",
         }
 
     def topk_scores(self, k: int = 10, variant: str = "rowsum",
@@ -131,9 +126,21 @@ class JaxSparseBackend(PathSimBackend):
 
             ckpt = CheckpointManager(checkpoint_dir, config=self._run_config(k))
         t = self.tiled
-        d = self.global_walks()
-        d_pad = np.zeros(t.n_tiles * t.tile_rows)
-        d_pad[: self.n] = d
+        # Row sums live on device for the whole pass; the merge loop below
+        # never brings a score tile to the host (sp.stream_merge_topk) —
+        # only the [tile, k] winners per completed row tile come back.
+        # Lazily built: a run resuming entirely from checkpoint never
+        # touches the graph at all.
+        d_dev = None
+
+        def rowsums_device():
+            nonlocal d_dev
+            if d_dev is None:
+                d_pad = np.zeros(t.n_tiles * t.tile_rows)
+                d_pad[: self.n] = self.global_walks()
+                d_dev = jnp.asarray(d_pad, dtype=t.dtype)
+            return d_dev
+
         vals = np.full((self.n, k), -np.inf)
         idxs = np.zeros((self.n, k), dtype=np.int64)
         for i in range(t.n_tiles):
@@ -145,31 +152,28 @@ class JaxSparseBackend(PathSimBackend):
                 vals[i0 : i0 + rows_here] = unit["vals"]
                 idxs[i0 : i0 + rows_here] = unit["idxs"]
                 continue
-            di = d_pad[i0 : i0 + t.tile_rows]
-            best_v = np.full((t.tile_rows, k), -np.inf)
-            best_i = np.zeros((t.tile_rows, k), dtype=np.int64)
+            ci = t.tile(i)
+            d_dev = rowsums_device()
+            di = d_dev[i0 : i0 + t.tile_rows]
+            best_v = jnp.full((t.tile_rows, k), -jnp.inf, dtype=t.dtype)
+            best_i = jnp.zeros((t.tile_rows, k), dtype=jnp.int32)
             for j in range(t.n_tiles):
                 j0 = j * t.tile_rows
-                m_tile = np.asarray(t.m_tile(i, j), dtype=np.float64)
-                denom = di[:, None] + d_pad[None, j0 : j0 + t.tile_rows]
-                with np.errstate(invalid="ignore", divide="ignore"):
-                    s = np.where(denom > 0, 2.0 * m_tile / np.where(denom > 0, denom, 1), 0.0)
-                # mask self-pairs and column padding
-                cols = np.arange(j0, j0 + t.tile_rows)
-                s[:, cols >= self.n] = -np.inf
-                if i == j:
-                    np.fill_diagonal(s, -np.inf)
-                merged_v = np.concatenate([best_v, s], axis=1)
-                merged_i = np.concatenate(
-                    [best_i, np.broadcast_to(cols, s.shape)], axis=1
+                best_v, best_i = sp.stream_merge_topk(
+                    ci, t.tile(j), di, d_dev[j0 : j0 + t.tile_rows],
+                    best_v, best_i,
+                    jnp.int32(i0), jnp.int32(j0), k=k, n_true=self.n,
                 )
-                top = np.argsort(-merged_v, axis=1, kind="stable")[:, :k]
-                best_v = np.take_along_axis(merged_v, top, axis=1)
-                best_i = np.take_along_axis(merged_i, top, axis=1)
-            vals[i0 : i0 + rows_here] = best_v[:rows_here]
-            idxs[i0 : i0 + rows_here] = best_i[:rows_here]
+            vals[i0 : i0 + rows_here] = np.asarray(
+                best_v[:rows_here], dtype=np.float64
+            )
+            idxs[i0 : i0 + rows_here] = np.asarray(
+                best_i[:rows_here], dtype=np.int64
+            )
             if ckpt is not None:
                 ckpt.save_unit(
-                    key, vals=best_v[:rows_here], idxs=best_i[:rows_here]
+                    key,
+                    vals=vals[i0 : i0 + rows_here],
+                    idxs=idxs[i0 : i0 + rows_here],
                 )
         return vals, idxs

@@ -100,6 +100,27 @@ def fold_half_chain(blocks) -> COOMatrix:
     return acc
 
 
+def half_chain_coo(hin, metapath) -> COOMatrix:
+    """Host-folded COO half-chain factor C for a symmetric metapath.
+
+    This is the one structural join the whole run needs — the sparse
+    analog of the reference's per-query 4-way motif join
+    (DPathSim_APVPA.py:72-84), computed once and reused by every backend.
+    """
+    if not metapath.is_symmetric:
+        raise ValueError("half_chain_coo requires a symmetric metapath")
+    blocks = []
+    for st in metapath.half():
+        c = coo_from_block(hin.block(st.relationship))
+        if st.reverse:
+            c = COOMatrix(
+                rows=c.cols, cols=c.rows, weights=c.weights,
+                shape=(c.shape[1], c.shape[0]),
+            )
+        blocks.append(c)
+    return fold_half_chain(blocks)
+
+
 # ---------------------------------------------------------------------------
 # Device side: static-shaped scatter + tile GEMMs
 # ---------------------------------------------------------------------------
@@ -130,6 +151,32 @@ def tile_rowsums(c_tile, colsum_total):
 def tile_topk(scores_tile, k: int):
     """Per-row top-k of a scores tile: values and column indices."""
     return jax.lax.top_k(scores_tile, k)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "n_true"))
+def stream_merge_topk(ci, cj, di, dj, best_v, best_i, i0, j0,
+                      k: int, n_true: int):
+    """Fold one [Ti, Tj] score tile into the running per-row top-k,
+    entirely on device: GEMM, normalize, mask (self-pairs + padding
+    columns ≥ n_true), merge with the carried [Ti, k] best. Only the
+    final [Ti, k] result ever reaches the host — O(N·k) transfer for the
+    whole streaming pass instead of O(N²) score traffic.
+
+    i0/j0 are traced scalars so every (i, j) tile pair reuses one
+    compiled program.
+    """
+    with jax.default_matmul_precision("highest"):
+        m = jnp.matmul(ci, cj.T)
+    denom = di[:, None] + dj[None, :]
+    s = jnp.where(denom > 0, 2.0 * m / jnp.where(denom > 0, denom, 1.0), 0.0)
+    rows = i0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    cols = j0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    s = jnp.where(cols >= n_true, -jnp.inf, s)
+    s = jnp.where(rows == cols, -jnp.inf, s)
+    merged_v = jnp.concatenate([best_v, s], axis=1)
+    merged_i = jnp.concatenate([best_i, cols], axis=1)
+    v, p = jax.lax.top_k(merged_v, k)
+    return v, jnp.take_along_axis(merged_i, p, axis=1)
 
 
 class TiledHalfChain:

@@ -47,6 +47,29 @@ _CLUSTER_ENV_VARS = (
 )
 
 
+def _distributed_client_exists() -> bool:
+    """True iff jax.distributed.initialize() already ran in this process
+    (e.g. by a SLURM/GKE launcher) — calling it again would raise."""
+    try:
+        return jax.distributed.global_state.client is not None
+    except Exception:
+        return False
+
+
+def _backends_initialized() -> bool:
+    """Whether any XLA backend has been created. Probes the private
+    xla_bridge helper when present (it avoids side effects); on JAX
+    versions that moved it, conservatively reports False, in which case
+    jax.distributed.initialize() itself still raises a clear error if
+    called too late."""
+    try:
+        from jax._src import xla_bridge
+
+        return bool(xla_bridge.backends_are_initialized())
+    except Exception:
+        return False
+
+
 def initialize_multihost(
     coordinator_address: str | None = None,
     num_processes: int | None = None,
@@ -64,15 +87,15 @@ def initialize_multihost(
     detected = any(v in os.environ for v in _CLUSTER_ENV_VARS)
     if not (explicit or detected):
         return jax.process_count() > 1
+    if _distributed_client_exists():
+        return jax.process_count() > 1  # launcher already ran initialize()
     # Order matters: jax.process_count() itself initializes the XLA
     # backend, after which jax.distributed.initialize() raises — so the
     # rendezvous decision must come first, guarded only by the (backend-
     # neutral) initialized check.
-    from jax._src import xla_bridge
-
-    if xla_bridge.backends_are_initialized():
+    if _backends_initialized():
         if jax.process_count() > 1:
-            return True  # launcher already initialized the cluster
+            return True  # cluster formed by other means
         raise RuntimeError(
             "initialize_multihost() must be called before any JAX backend "
             "use (jax.devices(), computations, device_put, …); move it to "

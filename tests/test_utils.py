@@ -31,9 +31,9 @@ def test_sparse_topk_resume(tmp_path):
     ckdir = str(tmp_path / "ck")
     v1, i1 = b.topk_scores(k=4, checkpoint_dir=ckdir)
     # fresh backend resumes entirely from checkpoint: results identical,
-    # and NO tile is ever computed (m_tile raising proves the resume path)
+    # and NO tile is ever densified (tile raising proves the resume path)
     b2 = create_backend("jax-sparse", hin, mp, tile_rows=64)
-    b2.tiled.m_tile = lambda *a: (_ for _ in ()).throw(
+    b2.tiled.tile = lambda *a: (_ for _ in ()).throw(
         AssertionError("tile recomputed despite complete checkpoint")
     )
     v2, i2 = b2.topk_scores(k=4, checkpoint_dir=ckdir)
