@@ -65,13 +65,20 @@ class PathSimBackend(abc.ABC):
     def scores_from_source(
         self, source_index: int, variant: str = "rowsum"
     ) -> np.ndarray:
-        d = self._denominators(variant)
-        row = self.pairwise_row(source_index)
+        # Counts are exact integers whatever the carry dtype (guarded ≤
+        # 2^24 for f32); normalizing in f64 on host makes the scores
+        # carry-dtype-independent.
+        d = np.asarray(self._denominators(variant), dtype=np.float64)
+        row = np.asarray(self.pairwise_row(source_index), dtype=np.float64)
         return pathsim.score_row(row, d[source_index], d, xp=np)
 
     def all_pairs_scores(self, variant: str = "rowsum") -> np.ndarray:
-        m = self.commuting_matrix()
-        rowsums = self.global_walks() if variant == "rowsum" else None
+        m = np.asarray(self.commuting_matrix(), dtype=np.float64)
+        rowsums = (
+            np.asarray(self.global_walks(), dtype=np.float64)
+            if variant == "rowsum"
+            else None
+        )
         return pathsim.score_matrix(m, rowsums=rowsums, variant=variant, xp=np)
 
 

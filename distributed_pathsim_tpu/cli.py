@@ -57,6 +57,18 @@ def build_parser() -> argparse.ArgumentParser:
         default=None,
         help="write a jax.profiler device trace (TensorBoard/Perfetto) here",
     )
+    p.add_argument(
+        "--ranking-out",
+        default=None,
+        help="with --top-k and no --source: write every node's top-k "
+        "ranking as TSV here",
+    )
+    p.add_argument(
+        "--checkpoint-dir",
+        default=None,
+        help="resumable ranking state (jax-sparse backend): completed row "
+        "tiles are skipped on restart",
+    )
     return p
 
 
@@ -105,6 +117,15 @@ def _run(args) -> int:
               f"(symmetric={metapath.is_symmetric}) backend={backend.name}")
 
     ran = False
+    if (args.source or args.source_id) and (
+        args.ranking_out or args.checkpoint_dir
+    ):
+        # --ranking-out/--checkpoint-dir belong to the all-sources mode
+        # (--top-k with no source); refuse rather than silently ignore.
+        raise ValueError(
+            "--ranking-out/--checkpoint-dir rank ALL sources and cannot "
+            "be combined with --source/--source-id"
+        )
     if args.source or args.source_id:
         logger = RunLogger(
             output_path=config.output, echo=config.echo, metrics_path=config.metrics
@@ -124,6 +145,18 @@ def _run(args) -> int:
             ):
                 print(f"  {score:.6f}  {label} ({nid})")
 
+    if args.top_k and not (args.source or args.source_id):
+        # No source = rank every node, the batched form of the
+        # reference's whole program. Streaming + resumable on jax-sparse.
+        vals, idxs = driver.rank_all(
+            k=args.top_k, checkpoint_dir=args.checkpoint_dir
+        )
+        print(f"Ranked top-{args.top_k} for all {vals.shape[0]} sources")
+        if args.ranking_out:
+            driver.write_ranking(args.ranking_out, vals, idxs)
+            print(f"Ranking written to {args.ranking_out}")
+        ran = True
+
     if args.all_pairs:
         scores = driver.run_all_pairs()
         n = scores.shape[0]
@@ -132,8 +165,8 @@ def _run(args) -> int:
         ran = True
 
     if not ran:
-        print("Nothing to do: pass --source/--source-id and/or --all-pairs",
-              file=sys.stderr)
+        print("Nothing to do: pass --source/--source-id, --top-k, "
+              "and/or --all-pairs", file=sys.stderr)
         return 2
     return 0
 
@@ -152,6 +185,8 @@ def _run_multipath(args) -> int:
         "--n-devices": args.n_devices is not None,
         "--output": args.output is not None,
         "--metrics": args.metrics is not None,
+        "--ranking-out": args.ranking_out is not None,
+        "--checkpoint-dir": args.checkpoint_dir is not None,
     }
     bad = [flag for flag, hit in unsupported.items() if hit]
     if bad:

@@ -121,6 +121,61 @@ class PathSimDriver:
         extrapolates to ~24 h of joins (SURVEY.md §6)."""
         return self.backend.all_pairs_scores(variant=self.variant)
 
+    def rank_all(self, k: int = 10, checkpoint_dir: str | None = None):
+        """Per-source top-k ranking for EVERY node: (values [N, k] f64,
+        indices [N, k] int64), self-pairs excluded.
+
+        This is the batched generalization of the reference's whole
+        program (one source against all targets, ``DPathSim_APVPA.py:
+        28-68``) to all sources at once. Dispatch, best first:
+        streaming tiled top-k (jax-sparse; supports checkpoint/resume,
+        never materializes N×N), fused on-device top-k (jax dense,
+        pallas on TPU), dense score matrix + argsort (any backend).
+        """
+        b = self.backend
+        if hasattr(b, "topk_scores") and self.variant == "rowsum":
+            vals, idxs = b.topk_scores(
+                k=k, variant=self.variant, checkpoint_dir=checkpoint_dir
+            )
+            return np.asarray(vals, dtype=np.float64), np.asarray(idxs)
+        if checkpoint_dir is not None:
+            raise ValueError(
+                "checkpointed ranking requires the jax-sparse backend "
+                "and the rowsum variant"
+            )
+        if (
+            self.variant == "rowsum"
+            and hasattr(b, "topk")
+            and b.metapath.is_symmetric
+        ):
+            vals, idxs = b.topk(k=k, mask_self=True)
+            return (
+                np.asarray(vals, dtype=np.float64),
+                np.asarray(idxs, dtype=np.int64),
+            )
+        scores = np.array(
+            b.all_pairs_scores(variant=self.variant), dtype=np.float64
+        )
+        np.fill_diagonal(scores, -np.inf)
+        idxs = np.argsort(-scores, axis=1, kind="stable")[:, :k]
+        vals = np.take_along_axis(scores, idxs, axis=1)
+        return vals, idxs.astype(np.int64)
+
+    def write_ranking(self, path: str, vals: np.ndarray, idxs: np.ndarray):
+        """TSV dump of a rank_all result: source_id, rank, target_id,
+        score — the machine-readable analog of the reference's log."""
+        with open(path, "w", encoding="utf-8") as f:
+            f.write("source_id\trank\ttarget_id\tscore\n")
+            for s in range(vals.shape[0]):
+                for r in range(vals.shape[1]):
+                    if not np.isfinite(vals[s, r]):
+                        continue  # k exceeded the real candidate count
+                    f.write(
+                        f"{self.index.ids[s]}\t{r + 1}\t"
+                        f"{self.index.ids[int(idxs[s, r])]}\t"
+                        f"{vals[s, r]:.17g}\n"
+                    )
+
     def top_k(self, source: str, k: int = 10, by_label: bool = True):
         """Ranked similar nodes — similarity *search*, the purpose PathSim
         serves in Sun et al."""

@@ -51,7 +51,9 @@ def _distributed_client_exists() -> bool:
     """True iff jax.distributed.initialize() already ran in this process
     (e.g. by a SLURM/GKE launcher) — calling it again would raise."""
     try:
-        return jax.distributed.global_state.client is not None
+        from jax._src.distributed import global_state
+
+        return global_state.client is not None
     except Exception:
         return False
 

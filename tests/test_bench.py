@@ -1,0 +1,60 @@
+"""The bench harness must always emit its one JSON line — including when
+the accelerator tunnel is unreachable (observed in practice: a wedged
+tunnel hangs inside device init with no exception). These tests pin the
+platform-probe fallback logic; the full TPU path is exercised by the
+round driver on real hardware."""
+
+import importlib
+import pathlib
+import sys
+
+
+def _bench():
+    repo = str(pathlib.Path(__file__).resolve().parents[1])
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    import bench
+
+    return importlib.reload(bench)
+
+
+def test_probe_honors_cpu_env(monkeypatch):
+    bench = _bench()
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    # env shortcut: no subprocess probe at all
+    monkeypatch.setattr(
+        bench.subprocess, "Popen",
+        lambda *a, **k: (_ for _ in ()).throw(AssertionError("probed")),
+    )
+    assert bench._device_platform() == "cpu"
+
+
+def test_probe_timeout_falls_back_to_cpu(monkeypatch):
+    bench = _bench()
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    killed = []
+
+    class Wedged:
+        pid = 99999999  # killpg target; must not exist
+
+        def wait(self, timeout=None):
+            raise bench.subprocess.TimeoutExpired(cmd="probe", timeout=timeout)
+
+    monkeypatch.setattr(bench.subprocess, "Popen", lambda *a, **k: Wedged())
+    monkeypatch.setattr(bench.os, "killpg", lambda pid, sig: killed.append(pid))
+    assert bench._device_platform() == "cpu"
+    assert killed == [Wedged.pid]  # wedged child is killed, never reaped
+
+
+def test_probe_success_reports_tpu(monkeypatch):
+    bench = _bench()
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+
+    class Ok:
+        pid = 1
+
+        def wait(self, timeout=None):
+            return 0
+
+    monkeypatch.setattr(bench.subprocess, "Popen", lambda *a, **k: Ok())
+    assert bench._device_platform() == "tpu"
