@@ -1,12 +1,12 @@
 """Multi-device sharded backend (BASELINE.json config 3).
 
 Routes the symmetric half-chain through parallel/sharded.py on a 1-D
-``dp`` mesh: rows of the commuting matrix are computed where their slice
-of the first adjacency block lives; the only collectives are one ``psum``
-(column totals for row sums) and either one ``all_gather`` or a
-``ppermute`` ring for the all-pairs product. Works identically on 8
-virtual CPU devices (tests) and real TPU slices — same program, same
-collectives, different mesh.
+``dp`` mesh: the half-chain factor C (host-folded from COO, [N, V]) is
+row-sharded so each device owns the rows of M it will compute; the only
+collectives are one ``psum`` (column totals for row sums) and either one
+``all_gather`` or a ``ppermute`` ring for the all-pairs product /
+distributed top-k. Works identically on 8 virtual CPU devices (tests)
+and real TPU slices — same program, same collectives, different mesh.
 """
 
 from __future__ import annotations
@@ -14,12 +14,12 @@ from __future__ import annotations
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops import chain
+from ..ops import sparse as sp
 from ..parallel.mesh import make_mesh
 from ..parallel.sharded import (
-    replicate,
     shard_first_block_rows,
     sharded_chain_outputs,
+    sharded_topk,
 )
 from .base import PathSimBackend, register_backend
 
@@ -45,17 +45,39 @@ class JaxShardedBackend(PathSimBackend):
         self.allpairs_strategy = allpairs_strategy
         self.n = hin.type_size(metapath.source_type)
 
-        host_blocks = chain.oriented_dense_blocks(
-            hin, metapath.half(), dtype=np.float32
-        )
+        # Sparse-first: fold the half-chain to COO on host and densify
+        # only the [N, V] factor C — V (the contracted width, e.g.
+        # #venues) is orders of magnitude smaller than the N×P adjacency
+        # this used to shard, so host memory and host→device transfer
+        # drop accordingly. The sharded program then starts at C (empty
+        # ``rest``): same collectives, far less data.
+        coo = sp.half_chain_coo(hin, metapath)
+        c_host = np.zeros(coo.shape, dtype=np.float64)
+        np.add.at(c_host, (coo.rows, coo.cols), coo.weights)
+        self._check_exact(c_host, dtype)
         self._first = shard_first_block_rows(
-            host_blocks[0].astype(np.dtype(dtype)), self.mesh
+            c_host.astype(np.dtype(dtype)), self.mesh
         )
-        self._rest = [
-            replicate(b.astype(np.dtype(dtype)), self.mesh) for b in host_blocks[1:]
-        ]
+        self._rest: list = []
         self._m: np.ndarray | None = None
         self._rowsums: np.ndarray | None = None
+
+    @staticmethod
+    def _check_exact(c_host: np.ndarray, dtype) -> None:
+        """f32 carries exact integers only to 2^24; a truncated count
+        would corrupt every score downstream, so refuse loudly (same
+        contract as the dense and tiled backends). Exact per-row check —
+        C entries are multiplicities, so no cheap bound on the rowsums
+        exists. O(N·V), trivial next to the assembly just done."""
+        if np.dtype(dtype) != np.float32:
+            return
+        rs = c_host @ c_host.sum(axis=0)
+        if rs.max(initial=0.0) >= 2**24:
+            raise OverflowError(
+                "path counts exceed f32 exact-integer range (2^24); "
+                "construct the backend with dtype=jnp.float64 "
+                "(requires JAX_ENABLE_X64)"
+            )
 
     def _compute(self, want_m: bool):
         if self._rowsums is None or (want_m and self._m is None):
@@ -80,3 +102,20 @@ class JaxShardedBackend(PathSimBackend):
 
     def pairwise_row(self, source_index: int) -> np.ndarray:
         return self.commuting_matrix()[source_index]
+
+    def topk(self, k: int = 10, mask_self: bool = True):
+        """Distributed per-row top-k via the ppermute ring: no device
+        ever holds more than an [n_loc, n_loc] score tile, and only
+        [N, k] winners come back to the host."""
+        vals, idxs = sharded_topk(
+            self._first,
+            tuple(self._rest),
+            mesh=self.mesh,
+            k=k,
+            n_true=self.n,
+            mask_self=mask_self,
+        )
+        return (
+            np.asarray(vals, dtype=np.float64)[: self.n],
+            np.asarray(idxs, dtype=np.int64)[: self.n],
+        )

@@ -31,7 +31,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .mesh import pad_to_multiple
-from .ring import ring_allpairs_rowblock
+from .ring import ring_allpairs_rowblock, ring_topk_rowblock
 
 
 def shard_first_block_rows(
@@ -98,3 +98,41 @@ def sharded_chain_outputs(
 
     m, rowsums = run(first, tuple(rest))
     return (m if want_m else None), rowsums
+
+
+@functools.partial(
+    jax.jit, static_argnames=("mesh", "axis", "k", "n_true", "mask_self")
+)
+def sharded_topk(
+    first: jax.Array,
+    rest: Sequence[jax.Array],
+    mesh: Mesh,
+    k: int,
+    n_true: int,
+    axis: str = "dp",
+    mask_self: bool = True,
+):
+    """Distributed per-row top-k without materializing any score block
+    bigger than [n_loc, n_loc]: local half-chain fold, one ``psum`` for
+    column totals, then the ``ppermute`` ring streams peer C-blocks and
+    folds score tiles into each device's running top-k
+    (ring.ring_topk_rowblock). Output is row-sharded [N_pad, k]."""
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(axis, None), tuple(P() for _ in rest)),
+        out_specs=(P(axis, None), P(axis, None)),
+    )
+    def run(first_local, rest_blocks):
+        with jax.default_matmul_precision("highest"):
+            c_local = first_local
+            for b in rest_blocks:
+                c_local = jnp.matmul(c_local, b)
+            colsum_total = jax.lax.psum(jnp.sum(c_local, axis=0), axis)
+            d_local = jnp.matmul(c_local, colsum_total)
+        return ring_topk_rowblock(
+            c_local, d_local, axis, k=k, n_true=n_true, mask_self=mask_self
+        )
+
+    return run(first, tuple(rest))

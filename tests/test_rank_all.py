@@ -33,8 +33,10 @@ def test_tiers_agree(hin, mp):
     v_np, i_np = _ranked_vals(hin, mp, "numpy")       # generic argsort tier
     v_jd, i_jd = _ranked_vals(hin, mp, "jax")         # fused topk tier
     v_sp, i_sp = _ranked_vals(hin, mp, "jax-sparse", tile_rows=64)  # streaming
+    v_sh, i_sh = _ranked_vals(hin, mp, "jax-sharded", n_devices=8)  # ring
     np.testing.assert_allclose(v_jd, v_np, atol=1e-6)
     np.testing.assert_allclose(v_sp, v_np, atol=1e-6)
+    np.testing.assert_allclose(v_sh, v_np, atol=1e-6)
 
 
 def test_checkpoint_roundtrip(hin, mp, tmp_path):

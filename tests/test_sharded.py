@@ -59,3 +59,77 @@ def test_asymmetric_rejected(dblp_small_hin):
     mp_asym = compile_metapath("APV", dblp_small_hin.schema)
     with pytest.raises(ValueError, match="symmetric"):
         create_backend("jax-sharded", dblp_small_hin, mp_asym)
+
+
+def test_distributed_topk_matches_oracle(dblp_small_hin, mp, oracle):
+    """Ring-streamed top-k == oracle argsort, across device counts."""
+    scores = oracle.all_pairs_scores()
+    np.fill_diagonal(scores, -np.inf)
+    expect_v = np.sort(scores, axis=1)[:, ::-1][:, :5]
+    for n in (1, 8):
+        b = create_backend("jax-sharded", dblp_small_hin, mp, n_devices=n)
+        vals, idxs = b.topk(k=5)
+        np.testing.assert_allclose(vals, expect_v, atol=1e-6)
+        # indices point at the claimed scores
+        took = np.take_along_axis(scores, idxs, axis=1)
+        np.testing.assert_allclose(vals, took, atol=1e-6)
+
+
+def test_topk_synthetic_vs_dense_backend():
+    from distributed_pathsim_tpu.data.synthetic import synthetic_hin
+
+    hin = synthetic_hin(500, 900, 40, seed=7)
+    mp_s = compile_metapath("APVPA", hin.schema)
+    dense_v, _ = create_backend("jax", hin, mp_s).topk(k=7)
+    shard_v, _ = create_backend("jax-sharded", hin, mp_s, n_devices=8).topk(k=7)
+    np.testing.assert_allclose(shard_v, dense_v, atol=1e-6)
+
+
+def test_overflow_guard_exact_and_dtype_aware():
+    """C entries are multiplicities: one author with 5000 papers at one
+    venue gives rowsum 25e6 > 2^24 even though every C entry is small.
+    f32 must refuse; f64 (the error's own remedy) must work."""
+    import jax.numpy as jnp
+
+    from distributed_pathsim_tpu.data.encode import (
+        AdjacencyBlock, EncodedHIN, TypeIndex,
+    )
+    from distributed_pathsim_tpu.data.schema import HINSchema
+
+    n_p = 5000
+    schema = HINSchema(
+        node_types=("author", "paper", "venue"),
+        relations={"author_of": ("author", "paper"),
+                   "submit_at": ("paper", "venue")},
+    )
+
+    def _idx(t, size):
+        return TypeIndex(
+            node_type=t, ids=(), labels=(), index_of={}, size_override=size
+        )
+
+    hin = EncodedHIN(
+        schema=schema,
+        indices={"author": _idx("author", 2), "paper": _idx("paper", n_p),
+                 "venue": _idx("venue", 1)},
+        blocks={
+            "author_of": AdjacencyBlock(
+                relationship="author_of", src_type="author", dst_type="paper",
+                rows=np.zeros(n_p, dtype=np.int32),
+                cols=np.arange(n_p, dtype=np.int32),
+                shape=(2, n_p),
+            ),
+            "submit_at": AdjacencyBlock(
+                relationship="submit_at", src_type="paper", dst_type="venue",
+                rows=np.arange(n_p, dtype=np.int32),
+                cols=np.zeros(n_p, dtype=np.int32),
+                shape=(n_p, 1),
+            ),
+        },
+    )
+    mp_big = compile_metapath("APVPA", schema)
+    with pytest.raises(OverflowError, match="2\\^24"):
+        create_backend("jax-sharded", hin, mp_big, n_devices=2)
+    b = create_backend("jax-sharded", hin, mp_big, n_devices=2,
+                       dtype=jnp.float64)
+    assert b.global_walks()[0] == n_p * n_p  # exact in f64
