@@ -25,9 +25,6 @@ from ..ops import pallas_kernels as pk
 from ..ops import sparse as sp
 from .base import PathSimBackend, register_backend
 
-# f32 represents every integer exactly up to 2**24.
-_F32_EXACT_INT_MAX = float(2**24)
-
 
 @jax.jit
 def _chain_outputs(blocks):
@@ -124,11 +121,7 @@ class JaxDenseBackend(PathSimBackend):
         return self._m, self._rowsums
 
     def _check_exact(self, rowsums: np.ndarray) -> None:
-        if self.dtype == jnp.float32 and rowsums.max(initial=0.0) >= _F32_EXACT_INT_MAX:
-            raise OverflowError(
-                "path counts exceed f32 exact-integer range (2^24); "
-                "rerun with dtype=jnp.float64 (requires JAX_ENABLE_X64)"
-            )
+        chain.check_exact_counts(rowsums.max(initial=0.0), self.dtype)
 
     def commuting_matrix(self) -> np.ndarray:
         return self._compute()[0]
@@ -155,8 +148,11 @@ class JaxDenseBackend(PathSimBackend):
         if not self._symmetric or variant != "rowsum":
             return super().all_pairs_scores(variant)
         c, rowsums = self._half()
-        if self.use_pallas and pk.fits_vmem(c.shape[1]):
-            scores = pk.fused_scores(c, rowsums)
+        if self.use_pallas:
+            if pk.fits_vmem(c.shape[1]):
+                scores = pk.fused_scores(c, rowsums)
+            else:
+                scores = pk.fused_scores_ktiled(c, rowsums)
         else:
             scores = pk.fused_scores_reference(c, rowsums)
         # Fetch + exactness check AFTER the kernel dispatch: dispatch is
@@ -170,7 +166,9 @@ class JaxDenseBackend(PathSimBackend):
         if not self._symmetric:
             raise ValueError("topk fast path requires a symmetric metapath")
         c, rowsums = self._half()
-        if self.use_pallas and pk.fits_vmem(c.shape[1]):
+        if self.use_pallas and not pk.fits_vmem(c.shape[1]):
+            vals, idxs = pk.fused_topk_ktiled(c, rowsums, k=k, mask_self=mask_self)
+        elif self.use_pallas:
             vals, idxs = pk.fused_topk(c, rowsums, k=k, mask_self=mask_self)
         else:
             scores = pk.fused_scores_reference(c, rowsums)

@@ -14,6 +14,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 import numpy as np
 
+from ..ops import chain
 from ..ops import sparse as sp
 from ..parallel.mesh import make_mesh
 from ..parallel.sharded import (
@@ -58,32 +59,26 @@ class JaxShardedBackend(PathSimBackend):
         self._first = shard_first_block_rows(
             c_host.astype(np.dtype(dtype)), self.mesh
         )
-        self._rest: list = []
         self._m: np.ndarray | None = None
         self._rowsums: np.ndarray | None = None
 
     @staticmethod
     def _check_exact(c_host: np.ndarray, dtype) -> None:
-        """f32 carries exact integers only to 2^24; a truncated count
-        would corrupt every score downstream, so refuse loudly (same
-        contract as the dense and tiled backends). Exact per-row check —
-        C entries are multiplicities, so no cheap bound on the rowsums
-        exists. O(N·V), trivial next to the assembly just done."""
-        if np.dtype(dtype) != np.float32:
+        """Exact per-row overflow check — C entries are multiplicities,
+        so no cheap bound on the rowsums exists. O(N·V), trivial next to
+        the assembly just done. Shared guard handles the
+        effective-device-dtype subtlety (f64 without x64 is still f32)."""
+        if chain.effective_device_dtype(dtype) != np.float32:
             return
         rs = c_host @ c_host.sum(axis=0)
-        if rs.max(initial=0.0) >= 2**24:
-            raise OverflowError(
-                "path counts exceed f32 exact-integer range (2^24); "
-                "construct the backend with dtype=jnp.float64 "
-                "(requires JAX_ENABLE_X64)"
-            )
+        chain.check_exact_counts(rs.max(initial=0.0), dtype)
 
     def _compute(self, want_m: bool):
         if self._rowsums is None or (want_m and self._m is None):
+            # rest=() — this backend always starts from the fully folded C
             m, rowsums = sharded_chain_outputs(
                 self._first,
-                tuple(self._rest),
+                (),
                 mesh=self.mesh,
                 allpairs_strategy=self.allpairs_strategy,
                 want_m=want_m,
@@ -109,7 +104,7 @@ class JaxShardedBackend(PathSimBackend):
         [N, k] winners come back to the host."""
         vals, idxs = sharded_topk(
             self._first,
-            tuple(self._rest),
+            (),
             mesh=self.mesh,
             k=k,
             n_true=self.n,

@@ -94,10 +94,9 @@ class MultiMetapathScorer:
             s, d = _batched_scores(self._c_stack)
             self._scores = np.asarray(s)
             self._rowsums = np.asarray(d, dtype=np.float64)
-            if self._rowsums.max(initial=0.0) >= 2**24:
-                raise OverflowError(
-                    "path counts exceed f32 exact-integer range (2^24)"
-                )
+            chain.check_exact_counts(
+                self._rowsums.max(initial=0.0), np.float32
+            )
         return self._scores, self._rowsums
 
     def scores(self) -> np.ndarray:

@@ -25,6 +25,43 @@ from ..data.encode import EncodedHIN
 from .metapath import MetaPath, Step
 
 
+# f32 represents every integer exactly up to 2**24. Path counts are
+# integers (SURVEY.md §7 hard parts): a silently rounded count corrupts
+# every downstream score, so backends refuse loudly past this range.
+F32_EXACT_INT_MAX = float(2**24)
+
+
+def effective_device_dtype(requested: Any) -> np.dtype:
+    """The dtype device arrays will actually carry.
+
+    Without JAX x64 mode, a float64 request silently downcasts to f32 at
+    ``device_put`` — so an overflow guard keyed on the *requested* dtype
+    would wave through exactly the corruption it exists to stop.
+    """
+    dt = np.dtype(requested)
+    if dt == np.float64:
+        import jax
+
+        if not jax.config.jax_enable_x64:
+            return np.dtype(np.float32)
+    return dt
+
+
+def check_exact_counts(max_count: float, requested_dtype: Any) -> None:
+    """Refuse when integer path counts exceed the exact-integer range of
+    the dtype the device will actually use (single shared guard — keep
+    every backend's contract identical)."""
+    if effective_device_dtype(requested_dtype) != np.float32:
+        return
+    if max_count >= F32_EXACT_INT_MAX:
+        raise OverflowError(
+            "path counts exceed f32 exact-integer range (2^24); "
+            "construct with dtype=jnp.float64 AND set JAX_ENABLE_X64=1 "
+            "(without x64 mode, f64 arrays silently downcast to f32 on "
+            "device)"
+        )
+
+
 def oriented_dense_blocks(
     hin: EncodedHIN,
     steps: Sequence[Step],

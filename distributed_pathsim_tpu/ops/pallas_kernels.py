@@ -23,31 +23,57 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 # Tile sizes: multiples of the f32 (8, 128) VMEM tile; 256×256 output
 # tiles keep C tiles + out tile well under VMEM while saturating the MXU.
 _BM = 256
 _BN = 256
+# Contraction tile for the K-tiled variants (wide half-chain factors,
+# e.g. APA where V = #papers): two [256, 512] C tiles + the f32
+# accumulator stay well inside VMEM at any V.
+_BK = 512
 
 
 def _ceil_to(x: int, k: int) -> int:
     return ((x + k - 1) // k) * k
 
 
-def _scores_kernel(c_i_ref, c_j_ref, d_i_ref, d_j_ref, out_ref):
-    """One [bm, bn] tile: matmul on MXU + normalization in VMEM.
-
-    HIGHEST precision forces full-f32 MXU passes: path counts are
-    integers, and the default bf16 passes truncate counts ≥ 257.
-    """
-    m = jnp.dot(
+def _tile_dot(c_i_ref, c_j_ref):
+    """One MXU pass of the tile product. HIGHEST precision forces
+    full-f32 passes: path counts are integers, and the default bf16
+    passes truncate counts ≥ 257."""
+    return jnp.dot(
         c_i_ref[:],
         c_j_ref[:].T,
         preferred_element_type=jnp.float32,
         precision=jax.lax.Precision.HIGHEST,
     )
+
+
+def _normalize(m, d_i_ref, d_j_ref):
+    """S = 2M / (d_i ⊕ d_j), zero where the denominator is zero —
+    shared by every kernel so their numerics can never drift apart."""
     denom = d_i_ref[:] + d_j_ref[:].T  # [bm,1] + [1,bn]
-    out_ref[:] = jnp.where(denom > 0, 2.0 * m / jnp.where(denom > 0, denom, 1.0), 0.0)
+    return jnp.where(denom > 0, 2.0 * m / jnp.where(denom > 0, denom, 1.0), 0.0)
+
+
+def _mask_tile(s, i, j, n_true: int, mask_self: bool):
+    """-inf out padding columns (index ≥ n_true) and, optionally,
+    self-pairs. Real zero-degree targets keep score 0 exactly like the
+    unfused oracle. Returns (masked s, global column indices)."""
+    bm, bn = s.shape
+    cols = j * bn + jax.lax.broadcasted_iota(jnp.int32, (bm, bn), 1)
+    s = jnp.where(cols < n_true, s, -jnp.inf)
+    if mask_self:
+        rows = i * bm + jax.lax.broadcasted_iota(jnp.int32, (bm, bn), 0)
+        s = jnp.where(rows == cols, -jnp.inf, s)
+    return s, cols
+
+
+def _scores_kernel(c_i_ref, c_j_ref, d_i_ref, d_j_ref, out_ref):
+    """One [bm, bn] tile: matmul on MXU + normalization in VMEM."""
+    out_ref[:] = _normalize(_tile_dot(c_i_ref, c_j_ref), d_i_ref, d_j_ref)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -101,32 +127,25 @@ def _topk_kernel(k: int, mask_self: bool, n_true: int, c_i_ref, c_j_ref,
     rounds of max-extract over the merged candidates — pure VPU reductions
     (k is small; each round is O(bm·(k_pad+bn)) vector work).
     """
+    i = pl.program_id(0)
     j = pl.program_id(1)
 
-    m = jnp.dot(
-        c_i_ref[:],
-        c_j_ref[:].T,
-        preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision.HIGHEST,
-    )
-    denom = d_i_ref[:] + d_j_ref[:].T
-    s = jnp.where(denom > 0, 2.0 * m / jnp.where(denom > 0, denom, 1.0), 0.0)
-    bm, bn = s.shape
-    col_base = j * bn
-    cols = col_base + jax.lax.broadcasted_iota(jnp.int32, (bm, bn), 1)
-    # Only PADDING columns (index ≥ n_true) are ruled out with -inf; real
-    # zero-degree targets keep score 0 exactly like the unfused oracle.
-    s = jnp.where(cols < n_true, s, -jnp.inf)
-    if mask_self:
-        i = pl.program_id(0)
-        rows = i * bm + jax.lax.broadcasted_iota(jnp.int32, (bm, bn), 0)
-        s = jnp.where(rows == cols, -jnp.inf, s)
+    s = _normalize(_tile_dot(c_i_ref, c_j_ref), d_i_ref, d_j_ref)
+    s, cols = _mask_tile(s, i, j, n_true, mask_self)
 
     @pl.when(j == 0)
     def _init():
         vals_ref[:] = jnp.full_like(vals_ref, -jnp.inf)
         idxs_ref[:] = jnp.zeros_like(idxs_ref)
 
+    _fold_tile_topk(k, s, cols, vals_ref, idxs_ref)
+
+
+def _fold_tile_topk(k: int, s, cols, vals_ref, idxs_ref):
+    """Merge one masked score tile ``s`` (with global column indices
+    ``cols``) into the running [bm, k_pad] best refs: k unrolled rounds
+    of max-extract over the merged candidates — pure VPU reductions."""
+    bm = s.shape[0]
     merged_v = jnp.concatenate([vals_ref[:], s], axis=1)
     merged_i = jnp.concatenate([idxs_ref[:], cols], axis=1)
     mcols = jax.lax.broadcasted_iota(jnp.int32, merged_v.shape, 1)
@@ -192,6 +211,128 @@ def fused_topk(
     return vals[:n, :k], idxs[:n, :k]
 
 
+# ---------------------------------------------------------------------------
+# K-tiled variants: the contraction (V) axis is tiled too, so arbitrarily
+# wide half-chain factors (APA: V = #papers) stay on the fused path. The
+# partial M tile accumulates in a VMEM scratch across the innermost grid
+# axis; normalization / top-k folding happens once, on the last K step.
+# ---------------------------------------------------------------------------
+
+
+def _scores_kernel_kt(n_kb, c_i_ref, c_j_ref, d_i_ref, d_j_ref, out_ref,
+                      acc_ref):
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    acc_ref[:] += _tile_dot(c_i_ref, c_j_ref)
+
+    @pl.when(kb == n_kb - 1)
+    def _finish():
+        out_ref[:] = _normalize(acc_ref[:], d_i_ref, d_j_ref)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def fused_scores_ktiled(c: jax.Array, rowsums: jax.Array,
+                        interpret: bool = False):
+    """fused_scores for contraction widths that exceed one VMEM tile."""
+    n, v = c.shape
+    n_pad = _ceil_to(max(n, 8), _BM)
+    bk = min(_BK, _ceil_to(max(v, 128), 128))
+    v_pad = _ceil_to(max(v, 128), bk)
+    n_kb = v_pad // bk
+    c_p = jnp.zeros((n_pad, v_pad), dtype=jnp.float32).at[:n, :v].set(c)
+    d_p = jnp.zeros((n_pad, 1), dtype=jnp.float32).at[:n, 0].set(rowsums)
+
+    grid = (n_pad // _BM, n_pad // _BN, n_kb)
+    out = pl.pallas_call(
+        functools.partial(_scores_kernel_kt, n_kb),
+        out_shape=jax.ShapeDtypeStruct((n_pad, n_pad), jnp.float32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_BM, bk), lambda i, j, kb: (i, kb)),
+            pl.BlockSpec((_BN, bk), lambda i, j, kb: (j, kb)),
+            pl.BlockSpec((_BM, 1), lambda i, j, kb: (i, 0)),
+            pl.BlockSpec((_BN, 1), lambda i, j, kb: (j, 0)),
+        ],
+        out_specs=pl.BlockSpec((_BM, _BN), lambda i, j, kb: (i, j)),
+        scratch_shapes=[pltpu.VMEM((_BM, _BN), jnp.float32)],
+        interpret=interpret,
+    )(c_p, c_p, d_p, d_p)
+    return out[:n, :n]
+
+
+def _topk_kernel_kt(k, mask_self, n_true, n_kb, c_i_ref, c_j_ref,
+                    d_i_ref, d_j_ref, vals_ref, idxs_ref, acc_ref):
+    # program_id must be read at kernel top level — inside a pl.when body
+    # it fails to lower in interpret mode.
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init_acc():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    acc_ref[:] += _tile_dot(c_i_ref, c_j_ref)
+
+    @pl.when(kb == n_kb - 1)
+    def _finish():
+        s = _normalize(acc_ref[:], d_i_ref, d_j_ref)
+        s, cols = _mask_tile(s, i, j, n_true, mask_self)
+
+        @pl.when(j == 0)
+        def _init_out():
+            vals_ref[:] = jnp.full_like(vals_ref, -jnp.inf)
+            idxs_ref[:] = jnp.zeros_like(idxs_ref)
+
+        _fold_tile_topk(k, s, cols, vals_ref, idxs_ref)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "mask_self", "interpret"))
+def fused_topk_ktiled(
+    c: jax.Array,
+    rowsums: jax.Array,
+    k: int = 10,
+    mask_self: bool = True,
+    interpret: bool = False,
+):
+    """fused_topk for contraction widths that exceed one VMEM tile."""
+    n, v = c.shape
+    n_pad = _ceil_to(max(n, 8), _BM)
+    bk = min(_BK, _ceil_to(max(v, 128), 128))
+    v_pad = _ceil_to(max(v, 128), bk)
+    n_kb = v_pad // bk
+    k_pad = _ceil_to(k, 128)
+    c_p = jnp.zeros((n_pad, v_pad), dtype=jnp.float32).at[:n, :v].set(c)
+    d_p = jnp.zeros((n_pad, 1), dtype=jnp.float32).at[:n, 0].set(rowsums)
+
+    grid = (n_pad // _BM, n_pad // _BN, n_kb)
+    vals, idxs = pl.pallas_call(
+        functools.partial(_topk_kernel_kt, k, mask_self, n, n_kb),
+        out_shape=(
+            jax.ShapeDtypeStruct((n_pad, k_pad), jnp.float32),
+            jax.ShapeDtypeStruct((n_pad, k_pad), jnp.int32),
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_BM, bk), lambda i, j, kb: (i, kb)),
+            pl.BlockSpec((_BN, bk), lambda i, j, kb: (j, kb)),
+            pl.BlockSpec((_BM, 1), lambda i, j, kb: (i, 0)),
+            pl.BlockSpec((_BN, 1), lambda i, j, kb: (j, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((_BM, k_pad), lambda i, j, kb: (i, 0)),
+            pl.BlockSpec((_BM, k_pad), lambda i, j, kb: (i, 0)),
+        ),
+        scratch_shapes=[pltpu.VMEM((_BM, _BN), jnp.float32)],
+        interpret=interpret,
+    )(c_p, c_p, d_p, d_p)
+    return vals[:n, :k], idxs[:n, :k]
+
+
 def pallas_supported() -> bool:
     """Pallas TPU kernels need a real TPU backend; elsewhere callers use
     interpret mode (tests) or the XLA reference."""
@@ -201,14 +342,16 @@ def pallas_supported() -> bool:
         return False
 
 
-# VMEM is ~16 MB/core; each grid step holds two [tile, v_pad] C blocks
-# plus the output tile. The kernels do not (yet) tile the contraction
-# dim, so wide half-chain factors (e.g. APA's author×paper C) must take
-# the XLA path instead of overflowing VMEM.
+# VMEM is ~16 MB/core; the single-pass kernels hold two [tile, v_pad] C
+# blocks plus the output tile. Wider half-chain factors (e.g. APA's
+# author×paper C) take the *_ktiled variants, which tile the contraction
+# axis and fit at any V.
 _VMEM_BUDGET_BYTES = 12 << 20
 
 
 def fits_vmem(v: int) -> bool:
+    """True when V fits the single-pass kernels' VMEM budget; callers
+    switch to the K-tiled kernels (not the XLA path) otherwise."""
     v_pad = _ceil_to(max(v, 128), 128)
     needed = (_BM + _BN) * v_pad * 4 + _BM * _BN * 4
     return needed <= _VMEM_BUDGET_BYTES

@@ -225,21 +225,22 @@ class TiledHalfChain:
         self.colsum_total = colsum
         # f32 carries exact integers only to 2^24; a silently truncated
         # count would corrupt every downstream score, so refuse loudly.
-        if np.dtype(dtype) == np.float32:
-            max_rowsum = float(colsum.sum())  # upper bound on any row sum
-            if max_rowsum >= 2**24:
-                self._check_exact_rowsums()
+        # Cheap bound first: c[i,v] ≤ colsum[v] gives
+        # rowsum_i = Σ_v c[i,v]·colsum[v] ≤ Σ_v colsum[v]²  (colsum.sum()
+        # is NOT a bound — C entries are multiplicities, not 0/1).
+        from . import chain as _chain
 
-    def _check_exact_rowsums(self) -> None:
+        if _chain.effective_device_dtype(dtype) == np.float32:
+            if float((colsum**2).sum()) >= _chain.F32_EXACT_INT_MAX:
+                self._check_exact_rowsums(dtype)
+
+    def _check_exact_rowsums(self, dtype) -> None:
         """Tight per-row check, only run when the cheap bound trips."""
+        from . import chain as _chain
+
         rs = np.zeros(self.n, dtype=np.float64)
         np.add.at(rs, self._rows, self._weights * self.colsum_total[self._cols])
-        if rs.max(initial=0.0) >= 2**24:
-            raise OverflowError(
-                "path counts exceed f32 exact-integer range (2^24); "
-                "construct TiledHalfChain with dtype=jnp.float64 "
-                "(requires JAX_ENABLE_X64)"
-            )
+        _chain.check_exact_counts(rs.max(initial=0.0), dtype)
 
     def tile(self, i: int) -> jax.Array:
         """Dense [tile_rows, V] tile i of C (padded rows are zero)."""

@@ -90,3 +90,27 @@ def test_asymmetric_chain(dblp_small_hin):
     np.testing.assert_array_equal(b.commuting_matrix(), a @ pv)
     np.testing.assert_array_equal(b.global_walks(), (a @ pv).sum(axis=1))
     np.testing.assert_array_equal(b.pairwise_row(5), (a @ pv)[5])
+
+
+def test_exactness_guard_tracks_effective_device_dtype():
+    """f64 without JAX x64 mode silently downcasts to f32 on device —
+    the shared overflow guard must treat that as f32, not wave it
+    through because f64 was *requested*."""
+    import jax
+    import pytest
+
+    from distributed_pathsim_tpu.ops import chain
+
+    # x64 is on in the test suite: f64 is honored, no ceiling
+    assert chain.effective_device_dtype(np.float64) == np.float64
+    chain.check_exact_counts(2.0**30, np.float64)  # no raise
+    try:
+        jax.config.update("jax_enable_x64", False)
+        assert chain.effective_device_dtype(np.float64) == np.float32
+        with pytest.raises(OverflowError, match="x64"):
+            chain.check_exact_counts(2.0**24, np.float64)
+    finally:
+        jax.config.update("jax_enable_x64", True)
+    assert chain.effective_device_dtype(np.float32) == np.float32
+    with pytest.raises(OverflowError):
+        chain.check_exact_counts(2.0**24, np.float32)

@@ -111,3 +111,54 @@ def test_fused_topk_zero_degree_targets_score_zero():
             continue
         row = dict(zip(np.asarray(idxs[i]).tolist(), np.asarray(vals[i]).tolist()))
         assert row.get(5) == 0.0
+
+
+@pytest.fixture(scope="module")
+def wide_cd(dblp_small_hin):
+    """APA: C = A_AP, V = #papers = 1001 — two K-blocks at bk=512."""
+    import jax.numpy as jnp
+
+    mp = compile_metapath("APA", dblp_small_hin.schema)
+    c = dblp_small_hin.block("author_of").to_dense(np.float32)
+    rowsums = np.asarray(c @ c.sum(axis=0), dtype=np.float32)
+    oracle = create_backend("numpy", dblp_small_hin, mp)
+    return jnp.asarray(c), jnp.asarray(rowsums), oracle
+
+
+def test_ktiled_scores_interpret(wide_cd):
+    c, d, oracle = wide_cd
+    got = np.asarray(pk.fused_scores_ktiled(c, d, interpret=True),
+                     dtype=np.float64)
+    np.testing.assert_allclose(got, oracle.all_pairs_scores(), atol=1e-7)
+
+
+def test_ktiled_topk_interpret(wide_cd):
+    c, d, oracle = wide_cd
+    vals, idxs = pk.fused_topk_ktiled(c, d, k=5, interpret=True)
+    scores = oracle.all_pairs_scores()
+    np.fill_diagonal(scores, -np.inf)
+    for i in (0, 3, 100, 769):
+        expect = np.sort(scores[i])[::-1][:5]
+        np.testing.assert_allclose(
+            np.asarray(vals[i], dtype=np.float64), expect, atol=1e-7
+        )
+        np.testing.assert_allclose(
+            scores[i][np.asarray(idxs[i])], expect, atol=1e-7
+        )
+
+
+def test_ktiled_matches_single_pass_on_narrow(cd):
+    """On a V that fits one tile, K-tiled (n_kb=1) must equal the
+    single-pass kernel bit for bit."""
+    c, d, _ = cd
+    a = np.asarray(pk.fused_scores(c, d, interpret=True))
+    b = np.asarray(pk.fused_scores_ktiled(c, d, interpret=True))
+    np.testing.assert_array_equal(a, b)
+
+
+def test_ktiled_topk_matches_single_pass_on_narrow(cd):
+    c, d, _ = cd
+    v1, i1 = pk.fused_topk(c, d, k=5, interpret=True)
+    v2, i2 = pk.fused_topk_ktiled(c, d, k=5, interpret=True)
+    np.testing.assert_array_equal(np.asarray(v1), np.asarray(v2))
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
