@@ -91,12 +91,23 @@ def coo_matmul(a: COOMatrix, b: COOMatrix) -> COOMatrix:
     )
 
 
+def _matmul_summed(a: COOMatrix, b: COOMatrix) -> COOMatrix:
+    """One coalesced COO product: the native C++ SpGEMM when built
+    (identical output: row-major sorted, exact integer accumulation),
+    else the numpy join."""
+    from ..native import coo_native
+
+    if coo_native.available():
+        return coo_native.coo_matmul_summed(a, b)
+    return coo_matmul(a, b).summed()
+
+
 def fold_half_chain(blocks) -> COOMatrix:
     """Fold oriented COO blocks left-to-right into the half-chain factor C
     (coalesced)."""
     acc = blocks[0]
     for b in blocks[1:]:
-        acc = coo_matmul(acc, b).summed()
+        acc = _matmul_summed(acc, b)
     return acc
 
 
