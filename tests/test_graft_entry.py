@@ -1,0 +1,124 @@
+"""Unit tests for the driver-contract helpers in __graft_entry__.py.
+
+The critical properties (VERDICT r1, items 2 and 7): provisioning virtual
+devices must never initialize the real accelerator backend — the config
+must be re-pointed at CPU *before* the first ``jax.devices()`` — and the
+``XLA_FLAGS`` mutation needed for the forced host device count must not
+leak into the parent environment after the first backend init consumed it.
+"""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import textwrap
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+ENTRY = REPO / "__graft_entry__.py"
+
+
+def _run_child(body: str) -> dict:
+    code = textwrap.dedent(
+        f"""
+        import importlib.util, json, os, sys
+        s = importlib.util.spec_from_file_location('g', {str(ENTRY)!r})
+        m = importlib.util.module_from_spec(s)
+        s.loader.exec_module(m)
+        {body}
+        """
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        timeout=180,
+        cwd=str(REPO),
+    )
+    assert proc.returncode == 0, proc.stderr
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def test_fresh_process_provisions_cpu_and_restores_flags():
+    # Child starts with a pre-existing (smaller) forced device count;
+    # after provisioning, the helper's own mutation must be gone and the
+    # original value restored — even though XLA actually initialized with
+    # the helper's replacement count.
+    out = _run_child(
+        """
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        ok = m._try_ensure_devices(4)
+        import jax
+        print(json.dumps({
+            "ok": ok,
+            "flags": os.environ.get("XLA_FLAGS"),
+            "platform": jax.devices()[0].platform,
+            "n_devices": len(jax.devices()),
+        }))
+        """
+    )
+    assert out["ok"] is True
+    assert out["platform"] == "cpu"  # never the real accelerator
+    assert out["n_devices"] >= 4  # the replacement count took effect...
+    # ...but the env shows the caller's original value again
+    assert out["flags"] == "--xla_force_host_platform_device_count=2"
+
+
+def test_fresh_process_unset_flags_stay_unset():
+    out = _run_child(
+        """
+        os.environ.pop("XLA_FLAGS", None)
+        ok = m._try_ensure_devices(4)
+        print(json.dumps({
+            "ok": ok,
+            "has_flags": "XLA_FLAGS" in os.environ,
+        }))
+        """
+    )
+    assert out["ok"] is True
+    assert out["has_flags"] is False
+
+
+def test_initialized_process_does_not_mutate_env():
+    # In this pytest process backends are already up (8 virtual CPU
+    # devices from conftest); the helper must use the cached device list
+    # and leave the environment alone.
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("graft_entry", str(ENTRY))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    import jax
+
+    jax.devices()  # force init so the already-initialized branch is taken
+
+    before = os.environ.get("XLA_FLAGS")
+    assert mod._try_ensure_devices(8) is True
+    assert mod._try_ensure_devices(10_000) is False  # short count: no clear
+    assert os.environ.get("XLA_FLAGS") == before
+
+    import jax
+
+    assert len(jax.devices()) >= 8  # backends untouched
+
+
+def test_device_flags_value_replaces_existing_count():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("graft_entry2", str(ENTRY))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    prev = os.environ.get("XLA_FLAGS")
+    try:
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2 --keep=1"
+        got = mod._device_flags_value(8)
+        assert "--xla_force_host_platform_device_count=8" in got
+        assert "--keep=1" in got
+        assert "count=2" not in got
+    finally:
+        if prev is None:
+            os.environ.pop("XLA_FLAGS", None)
+        else:
+            os.environ["XLA_FLAGS"] = prev
