@@ -108,13 +108,17 @@ def main() -> None:
         if platform == "tpu"
         else "author_pairs_per_sec_apvpa_8k_authors_top10_CPU_FALLBACK"
     )
+    # pairs/sec is not scale-invariant, so an 8k-author CPU number over
+    # the 32k-author TPU baseline would be apples-to-oranges — the
+    # fallback emits no ratio at all rather than a misleading one.
+    vs_baseline = value / BASELINE_PAIRS_PER_SEC if platform == "tpu" else None
     print(
         json.dumps(
             {
                 "metric": metric,
                 "value": value,
                 "unit": "pairs/sec",
-                "vs_baseline": value / BASELINE_PAIRS_PER_SEC,
+                "vs_baseline": vs_baseline,
             }
         )
     )

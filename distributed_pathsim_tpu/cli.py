@@ -90,6 +90,20 @@ def main(argv: list[str] | None = None) -> int:
 def _run(args) -> int:
     if "," in args.metapath:
         return _run_multipath(args)
+    if args.ranking_out or args.checkpoint_dir:
+        # Both flags belong to the all-sources ranking mode (--top-k with
+        # no source); refuse bad combinations up front — the source
+        # conflict first, since no --top-k value fixes that one.
+        if args.source or args.source_id:
+            raise ValueError(
+                "--ranking-out/--checkpoint-dir rank ALL sources and "
+                "cannot be combined with --source/--source-id"
+            )
+        if not args.top_k:
+            raise ValueError(
+                "--ranking-out/--checkpoint-dir require --top-k "
+                "(the all-sources ranking mode)"
+            )
     config = RunConfig(
         dataset=args.dataset,
         backend=args.backend,
@@ -106,7 +120,23 @@ def _run(args) -> int:
         echo=not args.quiet,
     )
 
-    hin, metapath, backend, driver = build(config)
+    from .utils.profiling import StageTimer
+
+    # One logger + timer for the whole run: bootstrap stage timings
+    # (load/encode, metapath compile, backend init) and compute stages
+    # all land in the same --metrics JSONL.
+    logger = RunLogger(
+        output_path=config.output, echo=config.echo, metrics_path=config.metrics
+    )
+    timer = StageTimer(logger)
+    try:
+        return _run_modes(args, config, logger, timer)
+    finally:
+        logger.close()
+
+
+def _run_modes(args, config, logger: RunLogger, timer) -> int:
+    hin, metapath, backend, driver = build(config, timer=timer)
     if config.echo:
         counts = {t: hin.type_size(t) for t in hin.schema.node_types}
         # The reference prints totals at load (DPathSim_APVPA.py:126-127).
@@ -117,19 +147,7 @@ def _run(args) -> int:
               f"(symmetric={metapath.is_symmetric}) backend={backend.name}")
 
     ran = False
-    if (args.source or args.source_id) and (
-        args.ranking_out or args.checkpoint_dir
-    ):
-        # --ranking-out/--checkpoint-dir belong to the all-sources mode
-        # (--top-k with no source); refuse rather than silently ignore.
-        raise ValueError(
-            "--ranking-out/--checkpoint-dir rank ALL sources and cannot "
-            "be combined with --source/--source-id"
-        )
     if args.source or args.source_id:
-        logger = RunLogger(
-            output_path=config.output, echo=config.echo, metrics_path=config.metrics
-        )
         result = driver.run_single_source(
             source=args.source or args.source_id,
             by_label=args.source is not None,
@@ -148,9 +166,10 @@ def _run(args) -> int:
     if args.top_k and not (args.source or args.source_id):
         # No source = rank every node, the batched form of the
         # reference's whole program. Streaming + resumable on jax-sparse.
-        vals, idxs = driver.rank_all(
-            k=args.top_k, checkpoint_dir=args.checkpoint_dir
-        )
+        with timer.stage("rank_all"):
+            vals, idxs = driver.rank_all(
+                k=args.top_k, checkpoint_dir=args.checkpoint_dir
+            )
         print(f"Ranked top-{args.top_k} for all {vals.shape[0]} sources")
         if args.ranking_out:
             driver.write_ranking(args.ranking_out, vals, idxs)
@@ -158,7 +177,8 @@ def _run(args) -> int:
         ran = True
 
     if args.all_pairs:
-        scores = driver.run_all_pairs()
+        with timer.stage("all_pairs"):
+            scores = driver.run_all_pairs()
         n = scores.shape[0]
         print(f"All-pairs scores: {n}x{n}, mean={scores.mean():.6g}, "
               f"max offdiag={_max_offdiag(scores):.6g}")

@@ -21,15 +21,27 @@ def load_dataset(path: str) -> EncodedHIN:
     return encode_hin(graph)
 
 
-def build(config: RunConfig) -> tuple[EncodedHIN, MetaPath, PathSimBackend, PathSimDriver]:
-    hin = load_dataset(config.dataset)
-    metapath = compile_metapath(config.metapath, hin.schema)
+def build(
+    config: RunConfig, timer=None
+) -> tuple[EncodedHIN, MetaPath, PathSimBackend, PathSimDriver]:
+    """``timer``: optional StageTimer; bootstrap phases (GEXF load +
+    encode, metapath compile, backend init — which for the sparse
+    backend includes the host half-chain fold) are recorded on it."""
+    if timer is None:
+        from .utils.profiling import StageTimer
+
+        timer = StageTimer()
+    with timer.stage("load_encode"):
+        hin = load_dataset(config.dataset)
+    with timer.stage("metapath_compile"):
+        metapath = compile_metapath(config.metapath, hin.schema)
     options = {}
     if config.n_devices is not None:
         options["n_devices"] = config.n_devices
     if config.dtype:
         options["dtype"] = _resolve_dtype(config.backend, config.dtype)
-    backend = create_backend(config.backend, hin, metapath, **options)
+    with timer.stage("backend_init"):
+        backend = create_backend(config.backend, hin, metapath, **options)
     driver = PathSimDriver(backend, variant=config.variant)
     return hin, metapath, backend, driver
 

@@ -57,6 +57,17 @@ def ring_allpairs_rowblock(c_local: jax.Array, axis: str) -> jax.Array:
     return m
 
 
+def _merge_topk_by_col(merged_v: jax.Array, merged_i: jax.Array, k: int):
+    """Top-k of each row of ``merged_v``, ties broken by ascending global
+    column index ``merged_i`` — the oracle's stable ``argsort(-scores)``
+    order. A bare ``lax.top_k`` would break ties by *merge position*,
+    which in a ring fold depends on the device's position and the device
+    count; the lexicographic two-key sort makes the returned indices
+    identical across backends and mesh sizes."""
+    neg_v, idx = jax.lax.sort((-merged_v, merged_i), num_keys=2)
+    return -neg_v[:, :k], idx[:, :k]
+
+
 def ring_topk_rowblock(
     c_local: jax.Array,
     d_local: jax.Array,
@@ -105,8 +116,7 @@ def ring_topk_rowblock(
             s = jnp.where(rows == cols, -jnp.inf, s)
         merged_v = jnp.concatenate([best_v, s], axis=1)
         merged_i = jnp.concatenate([best_i, cols], axis=1)
-        best_v, p = jax.lax.top_k(merged_v, k)
-        best_i = jnp.take_along_axis(merged_i, p, axis=1)
+        best_v, best_i = _merge_topk_by_col(merged_v, merged_i, k)
         block = jax.lax.ppermute(block, axis, perm)
         d_block = jax.lax.ppermute(d_block, axis, perm)
         return block, d_block, best_v, best_i

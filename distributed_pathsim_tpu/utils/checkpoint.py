@@ -37,6 +37,14 @@ class CheckpointManager:
         if config is not None:
             stored = self._done.get(self.CONFIG_KEY)
             if stored is not None and stored != config:
+                if stored.get("format") != config.get("format"):
+                    raise ValueError(
+                        f"checkpoint directory {directory} was written with "
+                        f"on-disk format {stored.get('format')!r} but this "
+                        f"version uses {config.get('format')!r}: the stored "
+                        "units cannot be resumed — delete the directory to "
+                        "re-run from scratch"
+                    )
                 raise ValueError(
                     f"checkpoint directory {directory} belongs to a different "
                     f"run: stored config {stored} != requested {config}"

@@ -38,9 +38,11 @@ class RunLogger:
         echo: bool = True,
         metrics_path: str | None = None,
     ):
-        self._file: IO[str] | None = (
-            open(output_path, "a", encoding="utf-8") if output_path else None
-        )
+        # The grammar file opens lazily on first write: a run that fails
+        # during bootstrap, or a mode that never emits the reference
+        # grammar (rank-all), must not leave a stray empty file behind.
+        self._output_path = output_path
+        self._file: IO[str] | None = None
         self._echo = echo
         self._metrics: IO[str] | None = (
             open(metrics_path, "a", encoding="utf-8") if metrics_path else None
@@ -70,7 +72,10 @@ class RunLogger:
         self._write(
             f"***Overall done in: {time.perf_counter() - self.overall_start}\n"
         )
-        self.close()
+        # Only the reference-grammar file ends here; the metrics channel
+        # stays open so post-run stage timings (e.g. a following rank-all
+        # or all-pairs phase) still land in the JSONL.
+        self._close_grammar_file()
 
     # -- structured channel (new capability) -------------------------------
 
@@ -88,8 +93,17 @@ class RunLogger:
         self._write(text + "\n")
 
     def _write(self, text: str) -> None:
+        if self._output_path is None:
+            return
+        if self._file is None:
+            self._file = open(self._output_path, "a", encoding="utf-8")
+        self._file.write(text)
+
+    def _close_grammar_file(self) -> None:
         if self._file is not None:
-            self._file.write(text)
+            self._file.close()
+            self._file = None
+        self._output_path = None  # a closed grammar channel stays closed
 
     def flush(self) -> None:
         if self._file is not None:
@@ -97,9 +111,7 @@ class RunLogger:
         sys.stdout.flush()
 
     def close(self) -> None:
-        if self._file is not None:
-            self._file.close()
-            self._file = None
+        self._close_grammar_file()
         if self._metrics is not None:
             self._metrics.close()
             self._metrics = None

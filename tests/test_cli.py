@@ -104,3 +104,85 @@ def test_multipath_rejects_unsupported_flags(dblp_small_path, capsys):
     ])
     assert rc == 1
     assert "--variant" in capsys.readouterr().err
+
+
+def test_ranking_flags_require_top_k(dblp_small_path, capsys):
+    rc = main([
+        "--dataset", dblp_small_path, "--backend", "numpy",
+        "--ranking-out", "/tmp/never_written.tsv", "--quiet",
+    ])
+    assert rc == 1
+    assert "--top-k" in capsys.readouterr().err
+
+
+def test_metrics_stage_records_single_source(dblp_small_path, tmp_path):
+    import json
+
+    metrics = tmp_path / "m.jsonl"
+    rc = main([
+        "--dataset", dblp_small_path, "--backend", "numpy",
+        "--source", "Didier Dubois", "--metrics", str(metrics), "--quiet",
+    ])
+    assert rc == 0
+    events = [json.loads(l) for l in metrics.read_text().splitlines()]
+    stage_events = [e for e in events if e.get("event") == "stage_time"]
+    stages = [e["stage"] for e in stage_events]
+    for want in (
+        "load_encode", "metapath_compile", "backend_init",
+        "device_denominators", "device_pairwise_row", "emit_log",
+    ):
+        assert want in stages, f"missing stage_time for {want}: {stages}"
+    assert all(e["seconds"] >= 0 for e in stage_events)
+
+
+def test_metrics_stage_records_rank_all(dblp_small_path, tmp_path):
+    import json
+
+    metrics = tmp_path / "m.jsonl"
+    rc = main([
+        "--dataset", dblp_small_path, "--backend", "numpy",
+        "--top-k", "3", "--metrics", str(metrics), "--quiet",
+    ])
+    assert rc == 0
+    events = [json.loads(l) for l in metrics.read_text().splitlines()]
+    stages = [e["stage"] for e in events if e.get("event") == "stage_time"]
+    assert "rank_all" in stages
+
+
+def test_rank_all_mode_leaves_no_stray_grammar_file(dblp_small_path, tmp_path):
+    out = tmp_path / "never.log"
+    rc = main([
+        "--dataset", dblp_small_path, "--backend", "numpy",
+        "--top-k", "2", "--output", str(out), "--quiet",
+    ])
+    assert rc == 0
+    assert not out.exists()  # rank-all never emits the reference grammar
+
+
+def test_overall_done_excludes_bootstrap(dblp_small_path, tmp_path):
+    # The grammar's overall clock starts at run begin (reference parity,
+    # DPathSim_APVPA.py:26), not at logger construction before build().
+    out = tmp_path / "o.log"
+    rc = main([
+        "--dataset", dblp_small_path, "--backend", "numpy",
+        "--source", "Didier Dubois", "--output", str(out), "--quiet",
+    ])
+    assert rc == 0
+    lines = out.read_text().splitlines()
+    overall = float(lines[-1].split(": ")[1])
+    stage_sum = sum(
+        float(l.split(": ")[1]) for l in lines if l.startswith("***Stage")
+    )
+    # overall covers the stages plus loop overhead, but not the multi-
+    # second GEXF parse that precedes the run
+    assert stage_sum <= overall < stage_sum + 2.0
+
+
+def test_source_plus_ranking_flags_conflict(dblp_small_path, capsys):
+    rc = main([
+        "--dataset", dblp_small_path, "--backend", "numpy",
+        "--source", "Didier Dubois", "--ranking-out", "/tmp/never.tsv",
+        "--quiet",
+    ])
+    assert rc == 1
+    assert "cannot be combined with --source" in capsys.readouterr().err

@@ -81,9 +81,14 @@ def test_metrics_channel(driver, tmp_path):
         output_path=None, echo=False, metrics_path=str(mpath)
     )
     driver.run_single_source("Didier Dubois", logger=logger)
-    rec = json.loads(mpath.read_text().splitlines()[0])
-    assert rec["event"] == "source_global_walk"
+    events = [json.loads(l) for l in mpath.read_text().splitlines()]
+    rec = next(e for e in events if e["event"] == "source_global_walk")
     assert rec["count"] == 3
+    # driver stage timings ride the same channel (device dispatch vs
+    # host formatting split)
+    stages = [e["stage"] for e in events if e["event"] == "stage_time"]
+    assert "device_denominators" in stages
+    assert "emit_log" in stages
 
 
 def _id_of(driver, label):

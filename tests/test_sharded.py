@@ -133,3 +133,19 @@ def test_overflow_guard_exact_and_dtype_aware():
     b = create_backend("jax-sharded", hin, mp_big, n_devices=2,
                        dtype=jnp.float64)
     assert b.global_walks()[0] == n_p * n_p  # exact in f64
+
+
+def test_topk_tie_break_invariant_across_device_counts(dblp_small_hin, mp):
+    """Tied scores (dblp_small is full of them) must resolve to the same
+    target indices no matter the mesh size: the ring merge breaks ties by
+    ascending global column, the same order a full-row lax.top_k uses on
+    the dense backend."""
+    dense_v, dense_i = create_backend("jax", dblp_small_hin, mp).topk(k=5)
+    dense_i = np.asarray(dense_i)
+    for n in (2, 8):
+        b = create_backend("jax-sharded", dblp_small_hin, mp, n_devices=n)
+        vals, idxs = b.topk(k=5)
+        np.testing.assert_allclose(
+            np.asarray(vals), np.asarray(dense_v), atol=1e-6
+        )
+        np.testing.assert_array_equal(np.asarray(idxs), dense_i)

@@ -133,3 +133,17 @@ def test_checkpoint_digest_sensitive_to_structure(tmp_path):
     b._c = mk([0, 1], [0, 1])  # same marginal sums, different structure
     d2 = b._run_config(3)["digest"]
     assert d1 != d2
+
+
+def test_checkpoint_format_change_has_actionable_message(tmp_path):
+    import pytest
+
+    from distributed_pathsim_tpu.utils.checkpoint import CheckpointManager
+
+    d = str(tmp_path / "ck")
+    CheckpointManager(d, config={"n": 5, "format": "stream-topk-v1"})
+    with pytest.raises(ValueError, match="delete the directory"):
+        CheckpointManager(d, config={"n": 5, "format": "stream-topk-v2"})
+    # a non-format mismatch keeps the generic different-run message
+    with pytest.raises(ValueError, match="different +run"):
+        CheckpointManager(d, config={"n": 6, "format": "stream-topk-v1"})
