@@ -14,15 +14,31 @@ from __future__ import annotations
 import jax.numpy as jnp
 import numpy as np
 
+import jax
+
 from ..ops import chain
 from ..ops import sparse as sp
 from ..parallel.mesh import make_mesh
+from ..parallel.multihost import distributed_first_block, make_hybrid_mesh
 from ..parallel.sharded import (
-    shard_first_block_rows,
     sharded_chain_outputs,
     sharded_topk,
 )
 from .base import PathSimBackend, register_backend
+
+
+def _fetch(x) -> np.ndarray:
+    """Bring a (possibly cross-process) sharded array to this host.
+
+    Single-process: plain fetch. Multi-process: ``np.asarray`` on an
+    array spanning non-addressable devices raises, so gather it to every
+    host first — callers of the dense-output APIs accept that cost; the
+    big-N paths (``topk``) only ever fetch [N, k] winners."""
+    if jax.process_count() == 1:
+        return np.asarray(x)
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(x, tiled=True))
 
 
 @register_backend("jax-sharded")
@@ -42,35 +58,69 @@ class JaxShardedBackend(PathSimBackend):
                 "jax-sharded requires a symmetric metapath (M = C Cᵀ); "
                 "use the dense backend for asymmetric chains"
             )
-        self.mesh = make_mesh(n_devices)
+        if jax.process_count() > 1:
+            # host_row_range's contiguous-ownership contract only holds
+            # for the hosts-outermost, process-is-granule construction;
+            # a flat jax.devices() slice could interleave processes (and
+            # slicing away another process's devices would break the
+            # local-data assembly outright).
+            if n_devices is not None:
+                raise ValueError(
+                    "n_devices is a single-process knob; a multi-host "
+                    "run always uses every device in the job"
+                )
+            self.mesh = make_hybrid_mesh(tp=1)
+        else:
+            self.mesh = make_mesh(n_devices)
         self.allpairs_strategy = allpairs_strategy
         self.n = hin.type_size(metapath.source_type)
 
-        # Sparse-first: fold the half-chain to COO on host and densify
-        # only the [N, V] factor C — V (the contracted width, e.g.
-        # #venues) is orders of magnitude smaller than the N×P adjacency
-        # this used to shard, so host memory and host→device transfer
-        # drop accordingly. The sharded program then starts at C (empty
-        # ``rest``): same collectives, far less data.
+        # Sparse-first: fold the half-chain to COO on host (O(nnz)); the
+        # dense [N, V] factor C is then assembled HOST-LOCALLY — each
+        # process densifies only its own row range and the global
+        # row-sharded array comes from make_array_from_process_local_data
+        # (parallel/multihost.py). Single-process that's the full range
+        # (identical result to a plain device_put); on a multi-host mesh
+        # no host ever materializes all of C, which is what the
+        # million-author configuration requires. The sharded program then
+        # starts at C (empty ``rest``): same collectives, far less data.
         coo = sp.half_chain_coo(hin, metapath)
-        c_host = np.zeros(coo.shape, dtype=np.float64)
-        np.add.at(c_host, (coo.rows, coo.cols), coo.weights)
-        self._check_exact(c_host, dtype)
-        self._first = shard_first_block_rows(
-            c_host.astype(np.dtype(dtype)), self.mesh
+        self._check_exact_coo(coo, dtype)
+        order = np.argsort(coo.rows, kind="stable")
+        rows_s = coo.rows[order]
+        cols_s = coo.cols[order]
+        w_s = coo.weights[order]
+        np_dtype = np.dtype(dtype)
+
+        def load_rows(a: int, b: int) -> np.ndarray:
+            lo, hi = np.searchsorted(rows_s, [a, b])
+            out = np.zeros((b - a, coo.shape[1]), dtype=np.float64)
+            np.add.at(out, (rows_s[lo:hi] - a, cols_s[lo:hi]), w_s[lo:hi])
+            return out.astype(np_dtype)  # exact: _check_exact_coo guards
+
+        self._first = distributed_first_block(
+            load_rows, coo.shape[0], coo.shape[1], self.mesh, dtype=np_dtype
         )
         self._m: np.ndarray | None = None
         self._rowsums: np.ndarray | None = None
 
     @staticmethod
-    def _check_exact(c_host: np.ndarray, dtype) -> None:
+    def _check_exact_coo(coo, dtype) -> None:
         """Exact per-row overflow check — C entries are multiplicities,
-        so no cheap bound on the rowsums exists. O(N·V), trivial next to
-        the assembly just done. Shared guard handles the
+        so no cheap bound on the rowsums exists. Computed straight from
+        the COO (O(nnz), no dense C needed): rowsum_i = Σ_e w_e ·
+        colsum[col_e] over this row's entries. Shared guard handles the
         effective-device-dtype subtlety (f64 without x64 is still f32)."""
         if chain.effective_device_dtype(dtype) != np.float32:
             return
-        rs = c_host @ c_host.sum(axis=0)
+        colsum = np.bincount(
+            coo.cols, weights=coo.weights, minlength=coo.shape[1]
+        )
+        rs = np.bincount(
+            coo.rows,
+            weights=coo.weights * colsum[coo.cols],
+            minlength=coo.shape[0],
+        )
         chain.check_exact_counts(rs.max(initial=0.0), dtype)
 
     def _compute(self, want_m: bool):
@@ -83,9 +133,9 @@ class JaxShardedBackend(PathSimBackend):
                 allpairs_strategy=self.allpairs_strategy,
                 want_m=want_m,
             )
-            self._rowsums = np.asarray(rowsums, dtype=np.float64)[: self.n]
+            self._rowsums = _fetch(rowsums).astype(np.float64)[: self.n]
             if want_m:
-                self._m = np.asarray(m, dtype=np.float64)[: self.n, : self.n]
+                self._m = _fetch(m).astype(np.float64)[: self.n, : self.n]
 
     def global_walks(self) -> np.ndarray:
         self._compute(want_m=False)
@@ -111,6 +161,6 @@ class JaxShardedBackend(PathSimBackend):
             mask_self=mask_self,
         )
         return (
-            np.asarray(vals, dtype=np.float64)[: self.n],
-            np.asarray(idxs, dtype=np.int64)[: self.n],
+            _fetch(vals).astype(np.float64)[: self.n],
+            _fetch(idxs).astype(np.int64)[: self.n],
         )

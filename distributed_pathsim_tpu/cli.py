@@ -69,12 +69,31 @@ def build_parser() -> argparse.ArgumentParser:
         help="resumable ranking state (jax-sparse backend): completed row "
         "tiles are skipped on restart",
     )
+    p.add_argument(
+        "--coordinator-address",
+        default=None,
+        help="multi-host rendezvous address host:port (jax.distributed); "
+        "run the same command on every host with its own --process-id",
+    )
+    p.add_argument(
+        "--num-processes",
+        type=int,
+        default=None,
+        help="total processes in the multi-host job",
+    )
+    p.add_argument(
+        "--process-id",
+        type=int,
+        default=None,
+        help="this process's rank in the multi-host job",
+    )
     return p
 
 
 def main(argv: list[str] | None = None) -> int:
     try:
         args = build_parser().parse_args(argv)
+        _init_multihost(args)  # before ANY backend touch (incl. profiler)
         from .utils.profiling import device_trace
 
         with device_trace(args.profile_dir):
@@ -85,6 +104,27 @@ def main(argv: list[str] | None = None) -> int:
         msg = exc.args[0] if exc.args else exc
         print(f"error: {msg}", file=sys.stderr)
         return 1
+
+
+def _init_multihost(args) -> None:
+    """jax.distributed rendezvous — the product path onto a multi-host
+    mesh (the reference reaches its distributed engine straight from
+    ``__main__``, ``DPathSim_APVPA.py:146-168``; this is the analog).
+    With no flags this is env-detection only and a single-process no-op,
+    so the same command works on a laptop and on every host of a pod."""
+    if (
+        args.num_processes is not None or args.process_id is not None
+    ) and args.coordinator_address is None:
+        raise ValueError(
+            "--num-processes/--process-id require --coordinator-address"
+        )
+    from .parallel.multihost import initialize_multihost
+
+    initialize_multihost(
+        coordinator_address=args.coordinator_address,
+        num_processes=args.num_processes,
+        process_id=args.process_id,
+    )
 
 
 def _run(args) -> int:
