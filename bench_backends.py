@@ -1,0 +1,134 @@
+"""Per-backend benchmark: one JSON line per engine tier.
+
+`bench.py` stays the driver's single-line headline (fused dense path on
+the real TPU). This harness measures the tiers that make the framework
+*distributed* — the capability the reference outsources to Spark:
+
+- ``jax``          dense fused top-k (single device) — the reference tier
+- ``jax-sharded``  ppermute-ring streaming top-k over the device mesh
+- ``jax-sparse``   host-COO fold + tiled streaming top-k (config-5 path)
+
+All three compute the identical product: every ordered author pair's
+PathSim score (reference row-sum semantics, SURVEY.md §3.3) reduced to a
+per-author top-10 ranking. Runs on the virtual CPU mesh by default — the
+distributed tiers need >1 device and the box has one TPU chip — so the
+metric is labeled with platform and device count; vs_baseline is null on
+CPU (pairs/sec is not scale-invariant vs the 32k TPU baseline).
+
+Usage: python bench_backends.py [--authors N] [--papers P] [--venues V]
+       [--devices D] [--top-k K] [--repeats R]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--authors", type=int, default=8192)
+    p.add_argument("--papers", type=int, default=12_000)
+    p.add_argument("--venues", type=int, default=384)
+    p.add_argument("--devices", type=int, default=8)
+    p.add_argument("--top-k", type=int, default=10)
+    p.add_argument("--repeats", type=int, default=3)
+    p.add_argument(
+        "--backends",
+        default="jax,jax-sharded,jax-sparse",
+        help="comma-separated backend tiers to measure",
+    )
+    return p.parse_args(argv)
+
+
+def _ensure_devices(n: int) -> str:
+    """Provision >= n virtual CPU devices (must run before backend init);
+    returns the platform label."""
+    import os
+    import re
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    want = f"--xla_force_host_platform_device_count={n}"
+    if "xla_force_host_platform_device_count" in flags:
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+", want, flags
+        )
+    else:
+        flags = (flags + " " + want).strip()
+    os.environ["XLA_FLAGS"] = flags
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    if len(jax.devices()) < n:
+        raise RuntimeError(
+            f"needed {n} devices, have {len(jax.devices())} — "
+            "XLA_FLAGS was parsed before this process could set it"
+        )
+    return "cpu"
+
+
+def bench_backend(name: str, hin, mp, k: int, repeats: int, n_devices: int):
+    """Best-of-``repeats`` wall-clock for a full rank-all top-k,
+    including the host fetch of the [N, k] winners."""
+    from distributed_pathsim_tpu.backends.base import create_backend
+
+    options = {}
+    if name == "jax-sharded":
+        options["n_devices"] = n_devices
+    backend = create_backend(name, hin, mp, **options)
+
+    def run():
+        if hasattr(backend, "topk"):
+            return backend.topk(k=k)
+        return backend.topk_scores(k=k)
+
+    run()  # warmup / compile
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        run()
+        times.append(time.perf_counter() - t0)
+    return min(times)
+
+
+def main(argv=None) -> None:
+    args = parse_args(argv)
+    platform = _ensure_devices(args.devices)
+
+    from distributed_pathsim_tpu.data.synthetic import synthetic_hin
+    from distributed_pathsim_tpu.ops.metapath import compile_metapath
+
+    hin = synthetic_hin(args.authors, args.papers, args.venues, seed=42)
+    mp = compile_metapath("APVPA", hin.schema)
+    pairs = float(args.authors) * (args.authors - 1)
+
+    for name in [b.strip() for b in args.backends.split(",") if b.strip()]:
+        best = bench_backend(
+            name, hin, mp, k=args.top_k, repeats=args.repeats,
+            n_devices=args.devices,
+        )
+        scale = f"{args.authors // 1000}k" if args.authors >= 1000 else str(args.authors)
+        # Only the sharded tier actually spans the mesh; labeling the
+        # single-device tiers with the mesh size would misread as a
+        # multi-device result.
+        n_dev = args.devices if name == "jax-sharded" else 1
+        print(
+            json.dumps(
+                {
+                    "metric": (
+                        f"author_pairs_per_sec_{name}_{scale}_authors_"
+                        f"top{args.top_k}_{platform}{n_dev}dev"
+                    ),
+                    "value": pairs / best,
+                    "unit": "pairs/sec",
+                    "vs_baseline": None,  # CPU mesh: no honest TPU ratio
+                    "seconds": best,
+                }
+            ),
+            flush=True,
+        )
+
+
+if __name__ == "__main__":
+    main()

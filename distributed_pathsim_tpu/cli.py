@@ -118,6 +118,15 @@ def _init_multihost(args) -> None:
         raise ValueError(
             "--num-processes/--process-id require --coordinator-address"
         )
+    if args.coordinator_address is not None and "," in args.metapath:
+        # Refuse BEFORE the rendezvous: the batched multi-metapath scorer
+        # is single-device, so forming a cluster for it would just run N
+        # identical copies.
+        raise ValueError(
+            "multi-metapath mode does not support --coordinator-address/"
+            "--num-processes/--process-id (it always runs the batched "
+            "single-device scorer)"
+        )
     from .parallel.multihost import initialize_multihost
 
     initialize_multihost(

@@ -88,7 +88,11 @@ def initialize_multihost(
     explicit = coordinator_address is not None
     detected = any(v in os.environ for v in _CLUSTER_ENV_VARS)
     if not (explicit or detected):
-        return jax.process_count() > 1
+        # No rendezvous requested: answer WITHOUT touching jax.process_count(),
+        # which would trigger the first backend initialization — on hosts
+        # whose accelerator tunnel can hang at init, a plain single-process
+        # CPU run must never pay that cost just to learn it isn't a cluster.
+        return _distributed_client_exists() and jax.process_count() > 1
     if _distributed_client_exists():
         return jax.process_count() > 1  # launcher already ran initialize()
     # Order matters: jax.process_count() itself initializes the XLA

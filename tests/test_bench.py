@@ -58,3 +58,30 @@ def test_probe_success_reports_tpu(monkeypatch):
 
     monkeypatch.setattr(bench.subprocess, "Popen", lambda *a, **k: Ok())
     assert bench._device_platform() == "tpu"
+
+
+def test_bench_backends_tiny_emits_all_tiers(capsys):
+    """bench_backends must emit one valid JSON line per engine tier."""
+    import json
+    import pathlib
+    import sys
+
+    repo = str(pathlib.Path(__file__).resolve().parents[1])
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    import bench_backends
+
+    bench_backends.main([
+        "--authors", "128", "--papers", "200", "--venues", "16",
+        "--devices", "8", "--repeats", "1",
+    ])
+    lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+    assert len(lines) == 3
+    names = set()
+    for line in lines:
+        rec = json.loads(line)
+        assert rec["unit"] == "pairs/sec"
+        assert rec["value"] > 0
+        assert rec["vs_baseline"] is None  # CPU mesh: no TPU ratio
+        names.add(rec["metric"].split("author_pairs_per_sec_")[1].split("_")[0])
+    assert names == {"jax", "jax-sharded", "jax-sparse"}

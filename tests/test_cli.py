@@ -186,3 +186,43 @@ def test_source_plus_ranking_flags_conflict(dblp_small_path, capsys):
     ])
     assert rc == 1
     assert "cannot be combined with --source" in capsys.readouterr().err
+
+
+def test_multipath_rejects_multihost_flags(dblp_small_path, capsys):
+    rc = main([
+        "--dataset", dblp_small_path,
+        "--metapath", "APVPA,APA",
+        "--coordinator-address", "127.0.0.1:1", "--all-pairs", "--quiet",
+    ])
+    assert rc == 1
+    assert "multi-metapath mode" in capsys.readouterr().err
+
+
+def test_numpy_backend_never_touches_jax_backends(dblp_small_path, tmp_path):
+    """A numpy-backend run must not initialize ANY JAX backend — on the
+    TPU host a backend init can hang on a wedged tunnel, and a pure-host
+    run has no reason to pay it (multihost detection included)."""
+    import pathlib
+    import subprocess
+    import sys
+    import textwrap
+
+    repo = str(pathlib.Path(__file__).resolve().parents[1])
+    code = textwrap.dedent(
+        f"""
+        from distributed_pathsim_tpu.cli import main
+        rc = main([
+            "--dataset", {dblp_small_path!r}, "--backend", "numpy",
+            "--source", "Didier Dubois", "--quiet",
+        ])
+        assert rc == 0
+        from jax._src import xla_bridge
+        assert not xla_bridge.backends_are_initialized(), "backend was initialized"
+        print("NO_BACKEND_OK")
+        """
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, timeout=240, cwd=repo,
+    )
+    assert "NO_BACKEND_OK" in proc.stdout, proc.stderr[-2000:]
