@@ -29,15 +29,25 @@ class JaxSparseBackend(PathSimBackend):
         metapath: MetaPath,
         tile_rows: int = 4096,
         dtype=jnp.float32,
+        exact_counts: bool = True,
         **options,
     ):
+        """``exact_counts=False`` waives the f32 2^24 exact-integer guard
+        for graphs whose path counts overflow it by construction (the
+        million-author regime): scores are scale-invariant in C, so the
+        cost is only f32 rounding (~√V·2⁻²⁴ relative, inside the ≤1e-5
+        gate), not truncation of the ranking product."""
         super().__init__(hin, metapath, **options)
         if not metapath.is_symmetric:
             raise ValueError("jax-sparse requires a symmetric metapath")
         self._c = sp.half_chain_coo(hin, metapath)
         self.n = self._c.shape[0]
+        self.exact_counts = exact_counts
         self.tiled = sp.TiledHalfChain(
-            self._c, tile_rows=min(tile_rows, max(self.n, 8)), dtype=dtype
+            self._c,
+            tile_rows=min(tile_rows, max(self.n, 8)),
+            dtype=dtype,
+            exact_counts=exact_counts,
         )
         self._rowsums: np.ndarray | None = None
         self._m: np.ndarray | None = None
@@ -102,6 +112,8 @@ class JaxSparseBackend(PathSimBackend):
             "tile_rows": int(self.tiled.tile_rows),
             "k": int(k),
             "metapath": self.metapath.name,
+            "dtype": str(np.dtype(self.tiled.dtype)),
+            "exact_counts": bool(self.exact_counts),
             # Bump whenever the numeric regime of saved units changes —
             # v2 = on-device f32 score division + lax.top_k tie-breaks.
             # Prevents resuming tiles written under different math.
@@ -124,7 +136,13 @@ class JaxSparseBackend(PathSimBackend):
         if checkpoint_dir is not None:
             from ..utils.checkpoint import CheckpointManager
 
-            ckpt = CheckpointManager(checkpoint_dir, config=self._run_config(k))
+            ckpt = CheckpointManager(
+                checkpoint_dir,
+                config=self._run_config(k),
+                # Directories written before these identity keys existed
+                # used exactly these values — keep them resumable.
+                config_defaults={"dtype": "float32", "exact_counts": True},
+            )
         t = self.tiled
         # Row sums live on device for the whole pass; the merge loop below
         # never brings a score tile to the host (sp.stream_merge_topk) —

@@ -10,6 +10,7 @@ Replaces the reference's hard-coded ``__main__`` block
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 
 from .backends.base import available_backends
@@ -118,16 +119,22 @@ def _init_multihost(args) -> None:
         raise ValueError(
             "--num-processes/--process-id require --coordinator-address"
         )
-    if args.coordinator_address is not None and "," in args.metapath:
-        # Refuse BEFORE the rendezvous: the batched multi-metapath scorer
-        # is single-device, so forming a cluster for it would just run N
+    from .parallel.multihost import _CLUSTER_ENV_VARS, initialize_multihost
+
+    if "," in args.metapath and (
+        args.coordinator_address is not None
+        or any(v in os.environ for v in _CLUSTER_ENV_VARS)
+    ):
+        # Refuse BEFORE the rendezvous — whether requested by flag or by
+        # a launcher's env vars: the batched multi-metapath scorer is
+        # single-device, so forming a cluster for it would just run N
         # identical copies.
         raise ValueError(
-            "multi-metapath mode does not support --coordinator-address/"
-            "--num-processes/--process-id (it always runs the batched "
-            "single-device scorer)"
+            "multi-metapath mode does not support multi-host rendezvous "
+            "(--coordinator-address flags or JAX_COORDINATOR_ADDRESS/"
+            "COORDINATOR_ADDRESS env); it always runs the batched "
+            "single-device scorer"
         )
-    from .parallel.multihost import initialize_multihost
 
     initialize_multihost(
         coordinator_address=args.coordinator_address,

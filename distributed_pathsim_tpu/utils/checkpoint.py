@@ -24,10 +24,19 @@ class CheckpointManager:
     MANIFEST = "manifest.json"
     CONFIG_KEY = "__config__"
 
-    def __init__(self, directory: str, config: dict | None = None):
+    def __init__(
+        self,
+        directory: str,
+        config: dict | None = None,
+        config_defaults: dict | None = None,
+    ):
         """``config``: the run's identity (graph fingerprint, tiling, k…).
         On resume it must equal the stored one — a reused directory from a
-        different run fails loudly instead of returning stale results."""
+        different run fails loudly instead of returning stale results.
+
+        ``config_defaults``: values assumed for keys ABSENT from the
+        stored config — lets a newer version add identity keys without
+        invalidating old directories whose runs used the defaults."""
         self.dir = pathlib.Path(directory)
         self.dir.mkdir(parents=True, exist_ok=True)
         self._manifest_path = self.dir / self.MANIFEST
@@ -36,6 +45,8 @@ class CheckpointManager:
             self._done = json.loads(self._manifest_path.read_text())
         if config is not None:
             stored = self._done.get(self.CONFIG_KEY)
+            if stored is not None and config_defaults:
+                stored = {**config_defaults, **stored}
             if stored is not None and stored != config:
                 if stored.get("format") != config.get("format"):
                     raise ValueError(

@@ -226,3 +226,13 @@ def test_numpy_backend_never_touches_jax_backends(dblp_small_path, tmp_path):
         capture_output=True, text=True, timeout=240, cwd=repo,
     )
     assert "NO_BACKEND_OK" in proc.stdout, proc.stderr[-2000:]
+
+
+def test_multipath_rejects_env_rendezvous(dblp_small_path, capsys, monkeypatch):
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "127.0.0.1:1")
+    rc = main([
+        "--dataset", dblp_small_path,
+        "--metapath", "APVPA,APA", "--all-pairs", "--quiet",
+    ])
+    assert rc == 1
+    assert "multi-metapath mode" in capsys.readouterr().err

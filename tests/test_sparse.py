@@ -69,3 +69,87 @@ def test_synthetic_sparse_vs_dense():
         sparse.commuting_matrix(), dense.commuting_matrix()
     )
     np.testing.assert_array_equal(sparse.global_walks(), dense.global_walks())
+
+
+def test_approx_mode_waives_guard_and_stays_within_gate():
+    """exact_counts=False: a graph whose counts overflow 2^24 (one
+    author with 5000 papers at one venue) must construct in f32 and give
+    scores within the 1e-5 relative gate of exact f64 arithmetic."""
+    import jax.numpy as jnp
+    import pytest
+
+    from distributed_pathsim_tpu.backends.base import create_backend
+    from distributed_pathsim_tpu.data.encode import (
+        AdjacencyBlock, EncodedHIN, TypeIndex,
+    )
+    from distributed_pathsim_tpu.data.schema import HINSchema
+    from distributed_pathsim_tpu.ops.metapath import compile_metapath
+
+    n_p = 5000
+    schema = HINSchema(
+        node_types=("author", "paper", "venue"),
+        relations={"author_of": ("author", "paper"),
+                   "submit_at": ("paper", "venue")},
+    )
+
+    def _idx(t, size):
+        return TypeIndex(
+            node_type=t, ids=(), labels=(), index_of={}, size_override=size
+        )
+
+    # author 0: n_p papers; author 1: 10 papers — all at one venue
+    a_rows = np.concatenate([np.zeros(n_p, np.int32), np.ones(10, np.int32)])
+    a_cols = np.concatenate(
+        [np.arange(n_p, dtype=np.int32), np.arange(10, dtype=np.int32)]
+    )
+    hin = EncodedHIN(
+        schema=schema,
+        indices={"author": _idx("author", 2), "paper": _idx("paper", n_p),
+                 "venue": _idx("venue", 1)},
+        blocks={
+            "author_of": AdjacencyBlock(
+                relationship="author_of", src_type="author", dst_type="paper",
+                rows=a_rows, cols=a_cols, shape=(2, n_p),
+            ),
+            "submit_at": AdjacencyBlock(
+                relationship="submit_at", src_type="paper", dst_type="venue",
+                rows=np.arange(n_p, dtype=np.int32),
+                cols=np.zeros(n_p, dtype=np.int32),
+                shape=(n_p, 1),
+            ),
+        },
+    )
+    mp = compile_metapath("APVPA", schema)
+
+    with pytest.raises(OverflowError):
+        create_backend("jax-sparse", hin, mp, dtype=jnp.float32)
+    b = create_backend(
+        "jax-sparse", hin, mp, dtype=jnp.float32, exact_counts=False
+    )
+    vals, idxs = b.topk_scores(k=1)
+    # exact arithmetic: C = [[n_p], [10]]; M = C Cᵀ; d = C·(n_p+10)
+    c = np.array([[n_p], [10.0]])
+    m = c @ c.T
+    d = (c @ c.sum(axis=0, keepdims=True).T).ravel()
+    s01 = 2 * m[0, 1] / (d[0] + d[1])
+    assert idxs[0, 0] == 1 and idxs[1, 0] == 0
+    np.testing.assert_allclose(vals[:, 0], [s01, s01], rtol=1e-5)
+
+
+def test_chunked_row_topk_matches_flat_topk():
+    """The hierarchical prefilter must be exactly lax.top_k, including
+    ascending-column tie-breaks, at widths around the chunk boundary."""
+    import jax
+
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(3)
+    for w in (63, 512, 513, 2048):
+        s = rng.integers(0, 5, size=(17, w)).astype(np.float32)  # many ties
+        cols = np.broadcast_to(np.arange(w, dtype=np.int32), (17, w))
+        from distributed_pathsim_tpu.ops.sparse import chunked_row_topk
+
+        v, c = chunked_row_topk(jnp.asarray(s), jnp.asarray(cols), k=7)
+        ev, ep = jax.lax.top_k(jnp.asarray(s), min(7, w))
+        np.testing.assert_array_equal(np.asarray(v), np.asarray(ev))
+        np.testing.assert_array_equal(np.asarray(c), np.asarray(ep))

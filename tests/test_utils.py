@@ -147,3 +147,23 @@ def test_checkpoint_format_change_has_actionable_message(tmp_path):
     # a non-format mismatch keeps the generic different-run message
     with pytest.raises(ValueError, match="different +run"):
         CheckpointManager(d, config={"n": 6, "format": "stream-topk-v1"})
+
+
+def test_checkpoint_config_defaults_keep_old_dirs_resumable(tmp_path):
+    from distributed_pathsim_tpu.utils.checkpoint import CheckpointManager
+
+    d = str(tmp_path / "old")
+    # a directory written before "dtype" existed as an identity key
+    CheckpointManager(d, config={"n": 5, "format": "v2"})
+    # new version adds the key; absent == default → resumes fine
+    CheckpointManager(
+        d, config={"n": 5, "format": "v2", "dtype": "float32"},
+        config_defaults={"dtype": "float32"},
+    )
+    import pytest
+
+    with pytest.raises(ValueError, match="different +run"):
+        CheckpointManager(
+            d, config={"n": 5, "format": "v2", "dtype": "float64"},
+            config_defaults={"dtype": "float32"},
+        )
