@@ -18,6 +18,15 @@ of C, row sums, all-pairs fused scoring, and fetch of the [N,10]
 rankings to host.
 Correctness of this exact path is pinned against the f64 oracle in
 tests/test_pallas.py and validated here on a spot row each run.
+
+TPU attempt protocol (this box reaches one TPU chip through a
+single-client tunnel that can hang indefinitely inside device init, and
+a client KILLED mid-init wedges the tunnel for hours): the real TPU
+bench runs in ONE child process that is never signalled from outside —
+it carries its own alarm and exits by itself. The parent waits past the
+child's deadline and falls back to CPU (at reduced scale, clearly
+labeled) only after the child has exited or overstayed; an overstayed
+child is abandoned, not killed. See also scripts/tpu_validation.py.
 """
 
 from __future__ import annotations
@@ -27,6 +36,7 @@ import os
 import signal
 import subprocess
 import sys
+import tempfile
 import time
 
 import numpy as np
@@ -38,50 +48,16 @@ N_PAPERS = 45_000
 N_VENUES = 384
 TOP_K = 10
 
-# A wedged accelerator tunnel hangs inside device init with no exception
-# to catch, which would leave the bench with NO output at all. Probe
-# liveness in a disposable subprocess first; on failure fall back to CPU
-# at reduced scale so the bench always emits its one JSON line (clearly
-# labeled, so a CPU number can't be mistaken for a TPU number).
-_PROBE_TIMEOUT_S = 240
 N_AUTHORS_CPU = 8192
+_CHILD_FLAG = "--tpu-child"
+_CHILD_ALARM_S = 900       # child gives itself 15 min, then exits rc=3
+_PARENT_EXTRA_S = 120      # parent waits this much past the child alarm
 
 
-def _device_platform() -> str:
-    """'tpu' if a real accelerator answers within the timeout, else 'cpu'.
-
-    The probe child is its own session and is never reaped after a
-    timeout kill: a tunnel-wedged child can sit in an uninterruptible
-    device syscall where even SIGKILL doesn't collect it, and a blocking
-    wait() there would defeat the whole watchdog.
-    """
-    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
-        return "cpu"
-    code = "import jax; assert jax.devices()[0].platform != 'cpu'"
-    proc = subprocess.Popen(
-        [sys.executable, "-c", code],
-        stdout=subprocess.DEVNULL,
-        stderr=subprocess.DEVNULL,
-        start_new_session=True,
-    )
-    try:
-        return "tpu" if proc.wait(timeout=_PROBE_TIMEOUT_S) == 0 else "cpu"
-    except subprocess.TimeoutExpired:
-        try:
-            os.killpg(proc.pid, signal.SIGKILL)
-        except OSError:
-            pass
-        return "cpu"
-
-
-def main() -> None:
-    platform = _device_platform()
-    if platform == "cpu":
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
-    n_authors = N_AUTHORS if platform == "tpu" else N_AUTHORS_CPU
-
+def run_bench(n_authors: int, platform: str) -> dict:
+    """The benchmark proper (platform-agnostic): build the synthetic
+    HIN, rank every author's top-10, best-of-3 wall-clock including the
+    host fetch. Returns the result record."""
     from distributed_pathsim_tpu.backends.base import create_backend
     from distributed_pathsim_tpu.data.synthetic import synthetic_hin
     from distributed_pathsim_tpu.ops.metapath import compile_metapath
@@ -104,24 +80,81 @@ def main() -> None:
     pairs = float(n_authors) * (n_authors - 1)  # ordered non-self pairs
     value = pairs / best
     metric = (
-        "author_pairs_per_sec_apvpa_32k_authors_top10"
+        f"author_pairs_per_sec_apvpa_{n_authors // 1024}k_authors_top{TOP_K}"
         if platform == "tpu"
-        else "author_pairs_per_sec_apvpa_8k_authors_top10_CPU_FALLBACK"
+        else (
+            f"author_pairs_per_sec_apvpa_{n_authors // 1024}k_authors_"
+            f"top{TOP_K}_CPU_FALLBACK"
+        )
     )
     # pairs/sec is not scale-invariant, so an 8k-author CPU number over
     # the 32k-author TPU baseline would be apples-to-oranges — the
     # fallback emits no ratio at all rather than a misleading one.
-    vs_baseline = value / BASELINE_PAIRS_PER_SEC if platform == "tpu" else None
-    print(
-        json.dumps(
-            {
-                "metric": metric,
-                "value": value,
-                "unit": "pairs/sec",
-                "vs_baseline": vs_baseline,
-            }
-        )
+    return {
+        "metric": metric,
+        "value": value,
+        "unit": "pairs/sec",
+        "vs_baseline": (
+            value / BASELINE_PAIRS_PER_SEC if platform == "tpu" else None
+        ),
+    }
+
+
+def _tpu_child() -> int:
+    """Run the real-TPU bench in this (child) process. Exits by itself,
+    always: rc 0 with a JSON line on success, rc 3 on self-timeout, rc 4
+    if the device turns out not to be a TPU. Never killed from outside."""
+    signal.signal(signal.SIGALRM, lambda *_: sys.exit(3))
+    signal.alarm(_CHILD_ALARM_S)
+    import jax
+
+    if jax.devices()[0].platform == "cpu":  # may hang; alarm covers it
+        return 4
+    record = run_bench(N_AUTHORS, "tpu")
+    print(json.dumps(record), flush=True)
+    return 0
+
+
+def _cpu_fallback() -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    print(json.dumps(run_bench(N_AUTHORS_CPU, "cpu")), flush=True)
+
+
+def main() -> None:
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        _cpu_fallback()
+        return
+    # One never-signalled child attempts the real TPU run.
+    out = tempfile.NamedTemporaryFile(
+        mode="w+", suffix=".bench.json", delete=False
     )
+    with out:
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), _CHILD_FLAG],
+            stdout=out,
+            stderr=subprocess.DEVNULL,
+            start_new_session=True,
+        )
+        deadline = time.monotonic() + _CHILD_ALARM_S + _PARENT_EXTRA_S
+        rc = None
+        while time.monotonic() < deadline:
+            rc = proc.poll()
+            if rc is not None:
+                break
+            time.sleep(2)
+    if rc == 0:
+        with open(out.name, encoding="utf-8") as f:
+            lines = [l for l in f.read().splitlines() if l.startswith("{")]
+        if lines:
+            print(lines[-1], flush=True)
+            os.unlink(out.name)
+            return
+    # Child failed, self-timed-out, or overstayed (left running, never
+    # killed — a SIGKILL mid-device-init is what wedges the tunnel).
+    os.unlink(out.name)
+    _cpu_fallback()
 
 
 def _validate_row(hin, vals: np.ndarray, idxs: np.ndarray, row: int) -> None:
@@ -144,4 +177,6 @@ def _dense(block) -> np.ndarray:
 
 
 if __name__ == "__main__":
+    if _CHILD_FLAG in sys.argv:
+        sys.exit(_tpu_child())
     main()

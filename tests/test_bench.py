@@ -1,7 +1,9 @@
 """The bench harness must always emit its one JSON line — including when
 the accelerator tunnel is unreachable (observed in practice: a wedged
-tunnel hangs inside device init with no exception). These tests pin the
-platform-probe fallback logic; the full TPU path is exercised by the
+tunnel hangs inside device init with no exception, and a client KILLED
+mid-init wedges it for hours). These tests pin the attempt protocol: one
+self-timing child, never signalled from outside; CPU fallback only after
+the child exits or overstays. The full TPU path is exercised by the
 round driver on real hardware."""
 
 import importlib
@@ -18,53 +20,90 @@ def _bench():
     return importlib.reload(bench)
 
 
-def test_probe_honors_cpu_env(monkeypatch):
+def test_cpu_env_skips_tpu_attempt(monkeypatch):
     bench = _bench()
     monkeypatch.setenv("JAX_PLATFORMS", "cpu")
-    # env shortcut: no subprocess probe at all
+    called = []
+    monkeypatch.setattr(bench, "_cpu_fallback", lambda: called.append(1))
     monkeypatch.setattr(
         bench.subprocess, "Popen",
-        lambda *a, **k: (_ for _ in ()).throw(AssertionError("probed")),
+        lambda *a, **k: (_ for _ in ()).throw(AssertionError("spawned")),
     )
-    assert bench._device_platform() == "cpu"
+    bench.main()
+    assert called == [1]
 
 
-def test_probe_timeout_falls_back_to_cpu(monkeypatch):
-    bench = _bench()
-    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
-    killed = []
-
-    class Wedged:
-        pid = 99999999  # killpg target; must not exist
-
-        def wait(self, timeout=None):
-            raise bench.subprocess.TimeoutExpired(cmd="probe", timeout=timeout)
-
-    monkeypatch.setattr(bench.subprocess, "Popen", lambda *a, **k: Wedged())
-    monkeypatch.setattr(bench.os, "killpg", lambda pid, sig: killed.append(pid))
-    assert bench._device_platform() == "cpu"
-    assert killed == [Wedged.pid]  # wedged child is killed, never reaped
-
-
-def test_probe_success_reports_tpu(monkeypatch):
+def test_successful_child_json_is_forwarded(monkeypatch, capsys):
     bench = _bench()
     monkeypatch.delenv("JAX_PLATFORMS", raising=False)
 
     class Ok:
-        pid = 1
+        def __init__(self, *a, stdout=None, **k):
+            stdout.write('{"metric": "m", "value": 1.0}\n')
+            stdout.flush()
 
-        def wait(self, timeout=None):
+        def poll(self):
             return 0
 
-    monkeypatch.setattr(bench.subprocess, "Popen", lambda *a, **k: Ok())
-    assert bench._device_platform() == "tpu"
+    monkeypatch.setattr(bench.subprocess, "Popen", Ok)
+    monkeypatch.setattr(
+        bench, "_cpu_fallback",
+        lambda: (_ for _ in ()).throw(AssertionError("fell back")),
+    )
+    bench.main()
+    assert capsys.readouterr().out.strip() == '{"metric": "m", "value": 1.0}'
+
+
+def test_overstaying_child_is_abandoned_not_killed(monkeypatch):
+    """A child that never exits must not be signalled; after the grace
+    deadline the parent falls back to CPU."""
+    bench = _bench()
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    monkeypatch.setattr(bench, "_CHILD_ALARM_S", 0)
+    monkeypatch.setattr(bench, "_PARENT_EXTRA_S", 1)
+
+    class Hung:
+        def __init__(self, *a, **k):
+            pass
+
+        def poll(self):
+            return None  # never exits
+
+        def kill(self):  # pragma: no cover - the bug this test pins
+            raise AssertionError("child was signalled")
+
+        terminate = kill
+        send_signal = kill
+
+    monkeypatch.setattr(bench.subprocess, "Popen", Hung)
+    fell_back = []
+    monkeypatch.setattr(bench, "_cpu_fallback", lambda: fell_back.append(1))
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    bench.main()
+    assert fell_back == [1]
+
+
+def test_failed_child_falls_back(monkeypatch):
+    bench = _bench()
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+
+    class SelfTimedOut:
+        def __init__(self, *a, **k):
+            pass
+
+        def poll(self):
+            return 3  # the child's own alarm exit
+
+    monkeypatch.setattr(bench.subprocess, "Popen", SelfTimedOut)
+    fell_back = []
+    monkeypatch.setattr(bench, "_cpu_fallback", lambda: fell_back.append(1))
+    bench.main()
+    assert fell_back == [1]
 
 
 def test_bench_backends_tiny_emits_all_tiers(capsys):
     """bench_backends must emit one valid JSON line per engine tier."""
     import json
-    import pathlib
-    import sys
 
     repo = str(pathlib.Path(__file__).resolve().parents[1])
     if repo not in sys.path:
