@@ -44,25 +44,28 @@ def parse_args(argv=None):
 
 def _ensure_devices(n: int) -> str:
     """Provision >= n virtual CPU devices (must run before backend init);
-    returns the platform label."""
+    returns the platform label. XLA_FLAGS is restored once XLA has parsed
+    it (first ``jax.devices()`` call) so the forced count never leaks
+    into a later subprocess doing real single-chip work."""
     import os
-    import re
 
-    flags = os.environ.get("XLA_FLAGS", "")
-    want = f"--xla_force_host_platform_device_count={n}"
-    if "xla_force_host_platform_device_count" in flags:
-        flags = re.sub(
-            r"--xla_force_host_platform_device_count=\d+", want, flags
-        )
-    else:
-        flags = (flags + " " + want).strip()
-    os.environ["XLA_FLAGS"] = flags
+    from distributed_pathsim_tpu.utils.xla_flags import device_flags_value
+
+    prev_flags = os.environ.get("XLA_FLAGS")
+    os.environ["XLA_FLAGS"] = device_flags_value(n)
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    if len(jax.devices()) < n:
+    try:
+        have = len(jax.devices())  # first backend init parses XLA_FLAGS
+    finally:
+        if prev_flags is None:
+            os.environ.pop("XLA_FLAGS", None)
+        else:
+            os.environ["XLA_FLAGS"] = prev_flags
+    if have < n:
         raise RuntimeError(
-            f"needed {n} devices, have {len(jax.devices())} — "
+            f"needed {n} devices, have {have} — "
             "XLA_FLAGS was parsed before this process could set it"
         )
     return "cpu"

@@ -44,6 +44,29 @@ def build_parser() -> argparse.ArgumentParser:
         help="comma-separated per-metapath ensemble weights (multi-path mode)",
     )
     p.add_argument("--variant", default="rowsum", choices=list(VARIANTS))
+    p.add_argument(
+        "--platform",
+        default="auto",
+        choices=("auto", "cpu", "tpu"),
+        help="pin the JAX platform before any device touch: 'cpu' never "
+        "initializes an accelerator (safe on hosts whose TPU tunnel can "
+        "hang); 'tpu' fails loudly instead of silently falling back to "
+        "CPU; 'auto' keeps JAX's own resolution",
+    )
+    p.add_argument(
+        "--tile-rows",
+        type=int,
+        default=None,
+        help="jax-sparse: rows per streaming tile (memory/throughput "
+        "trade-off for the million-author regime)",
+    )
+    p.add_argument(
+        "--approx",
+        action="store_true",
+        help="jax-sparse: waive the f32 exact-integer-count guard for "
+        "graphs whose path counts exceed 2^24 (scores stay within the "
+        "1e-5 gate; only the guard is waived)",
+    )
     p.add_argument("--source", default=None, help="source node label (e.g. author name)")
     p.add_argument("--source-id", default=None, help="source node id (e.g. author_395340)")
     p.add_argument("--output", default=None, help="reference-grammar log file")
@@ -94,7 +117,8 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv: list[str] | None = None) -> int:
     try:
         args = build_parser().parse_args(argv)
-        _init_multihost(args)  # before ANY backend touch (incl. profiler)
+        _apply_platform(args.platform)  # before ANY backend touch
+        _init_multihost(args)  # …and before the profiler, too
         from .utils.profiling import device_trace
 
         with device_trace(args.profile_dir):
@@ -105,6 +129,49 @@ def main(argv: list[str] | None = None) -> int:
         msg = exc.args[0] if exc.args else exc
         print(f"error: {msg}", file=sys.stderr)
         return 1
+
+
+def _apply_platform(platform: str) -> None:
+    """Pin the JAX platform before anything can initialize a backend.
+
+    The reference pins its engine with a hard-coded env var
+    (``DPathSim_APVPA.py:146-148``); this is the configurable analog.
+    ``cpu`` hard-pins host execution — the Quickstart-safe mode on
+    machines whose accelerator tunnel can hang inside device init.
+    ``tpu`` only *clears* an inherited cpu pin rather than forcing the
+    platform name (TPU plugins register under site-specific names);
+    the accelerator presence check happens after backend init, in
+    :func:`_require_tpu`.
+    """
+    if platform == "auto":
+        return
+    import jax
+
+    if platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+        return
+    if (os.environ.get("JAX_PLATFORMS", "") or "").strip().lower() == "cpu":
+        # An inherited cpu pin would make --platform tpu a guaranteed
+        # failure; clear it (None = JAX's own resolution, accelerators
+        # first) — but only while no backend exists to re-resolve.
+        try:
+            from jax._src import xla_bridge
+
+            initialized = bool(xla_bridge.backends_are_initialized())
+        except Exception:
+            initialized = False
+        if not initialized:
+            jax.config.update("jax_platforms", None)
+
+
+def _require_tpu() -> None:
+    import jax
+
+    if jax.devices()[0].platform == "cpu":
+        raise ValueError(
+            "--platform tpu: no accelerator available (JAX resolved to "
+            "cpu); run with --platform auto/cpu or fix the TPU runtime"
+        )
 
 
 def _init_multihost(args) -> None:
@@ -121,10 +188,10 @@ def _init_multihost(args) -> None:
         )
     from .parallel.multihost import _CLUSTER_ENV_VARS, initialize_multihost
 
-    if "," in args.metapath and (
-        args.coordinator_address is not None
-        or any(v in os.environ for v in _CLUSTER_ENV_VARS)
-    ):
+    rendezvous_requested = args.coordinator_address is not None or any(
+        v in os.environ for v in _CLUSTER_ENV_VARS
+    )
+    if "," in args.metapath and rendezvous_requested:
         # Refuse BEFORE the rendezvous — whether requested by flag or by
         # a launcher's env vars: the batched multi-metapath scorer is
         # single-device, so forming a cluster for it would just run N
@@ -135,12 +202,43 @@ def _init_multihost(args) -> None:
             "COORDINATOR_ADDRESS env); it always runs the batched "
             "single-device scorer"
         )
+    if rendezvous_requested and args.backend != "jax-sharded":
+        # Same failure class for every other backend: none of them is
+        # cluster-aware, so N processes would each run the identical full
+        # computation and interleave appends into any shared --output/
+        # --ranking-out/--checkpoint-dir path.
+        raise ValueError(
+            f"backend {args.backend!r} is single-process; multi-host "
+            "rendezvous requires --backend jax-sharded"
+        )
 
-    initialize_multihost(
+    multi = initialize_multihost(
         coordinator_address=args.coordinator_address,
         num_processes=args.num_processes,
         process_id=args.process_id,
     )
+    if multi:
+        import jax
+
+        if args.backend != "jax-sharded":
+            # Covers clusters formed without any flags/env we can see —
+            # e.g. a launcher that ran jax.distributed.initialize()
+            # before main(). Same failure class as the pre-rendezvous
+            # guard above: N identical single-process computations.
+            raise ValueError(
+                f"backend {args.backend!r} is single-process; this is a "
+                f"{jax.process_count()}-process cluster — use "
+                "--backend jax-sharded"
+            )
+        if jax.process_index() != 0:
+            # SPMD compute spans all processes, but host-side artifacts
+            # (reference-grammar log, ranking TSV, stdout echo) must be
+            # written once — the same command runs on every host, so any
+            # shared path would otherwise get N interleaved appends.
+            args.output = None
+            args.metrics = None
+            args.ranking_out = None
+            args.quiet = True
 
 
 def _run(args) -> int:
@@ -160,6 +258,11 @@ def _run(args) -> int:
                 "--ranking-out/--checkpoint-dir require --top-k "
                 "(the all-sources ranking mode)"
             )
+    if (args.tile_rows is not None or args.approx) and args.backend != "jax-sparse":
+        raise ValueError(
+            "--tile-rows/--approx tune the streaming tiled path and "
+            "require --backend jax-sparse"
+        )
     config = RunConfig(
         dataset=args.dataset,
         backend=args.backend,
@@ -173,6 +276,8 @@ def _run(args) -> int:
         top_k=args.top_k,
         n_devices=args.n_devices,
         dtype=args.dtype,
+        tile_rows=args.tile_rows,
+        approx=args.approx,
         echo=not args.quiet,
     )
 
@@ -193,6 +298,8 @@ def _run(args) -> int:
 
 def _run_modes(args, config, logger: RunLogger, timer) -> int:
     hin, metapath, backend, driver = build(config, timer=timer)
+    if args.platform == "tpu":
+        _require_tpu()  # backend init just resolved the platform
     if config.echo:
         counts = {t: hin.type_size(t) for t in hin.schema.node_types}
         # The reference prints totals at load (DPathSim_APVPA.py:126-127).
@@ -263,6 +370,8 @@ def _run_multipath(args) -> int:
         "--metrics": args.metrics is not None,
         "--ranking-out": args.ranking_out is not None,
         "--checkpoint-dir": args.checkpoint_dir is not None,
+        "--tile-rows": args.tile_rows is not None,
+        "--approx": args.approx,
     }
     bad = [flag for flag, hit in unsupported.items() if hit]
     if bad:
@@ -272,6 +381,8 @@ def _run_multipath(args) -> int:
         )
 
     hin = load_dataset(args.dataset)
+    if args.platform == "tpu":
+        _require_tpu()  # load_dataset stays host-side; check before compute
     names = [s.strip() for s in args.metapath.split(",") if s.strip()]
     weights = (
         [float(w) for w in args.weights.split(",")] if args.weights else None

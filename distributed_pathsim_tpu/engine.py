@@ -40,6 +40,10 @@ def build(
         options["n_devices"] = config.n_devices
     if config.dtype:
         options["dtype"] = _resolve_dtype(config.backend, config.dtype)
+    if config.tile_rows is not None:
+        options["tile_rows"] = config.tile_rows
+    if config.approx:
+        options["exact_counts"] = False
     with timer.stage("backend_init"):
         backend = create_backend(config.backend, hin, metapath, **options)
     driver = PathSimDriver(backend, variant=config.variant)

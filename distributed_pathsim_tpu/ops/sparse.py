@@ -186,7 +186,13 @@ def chunked_row_topk(s, cols, k: int, chunk: int = 512):
     pad = (-w) % chunk
     if pad:
         s = jnp.pad(s, ((0, 0), (0, pad)), constant_values=-jnp.inf)
-        cols = jnp.pad(cols, ((0, 0), (0, pad)))
+        # Continue each row's column ids past the edge (not constant 0):
+        # if a padding slot is ever selected (row with fewer than k
+        # candidates at pathological k/chunk combinations), it must not
+        # alias global column 0 — the flat-lax.top_k contract reports
+        # in-order positions, and a monotone continuation preserves that.
+        cont = cols[:, -1:] + 1 + jnp.arange(pad, dtype=cols.dtype)
+        cols = jnp.concatenate([cols, cont], axis=1)
     n_chunks = s.shape[1] // chunk
     kk = min(k, chunk)
     v3, p3 = jax.lax.top_k(s.reshape(t, n_chunks, chunk), kk)

@@ -100,10 +100,13 @@ class RunLogger:
         self._file.write(text)
 
     def _close_grammar_file(self) -> None:
+        # _output_path survives the close: the file opens in append mode,
+        # so a library caller reusing one logger for a second
+        # run_single_source call transparently reopens and appends —
+        # writes after overall_done() must never be dropped silently.
         if self._file is not None:
             self._file.close()
             self._file = None
-        self._output_path = None  # a closed grammar channel stays closed
 
     def flush(self) -> None:
         if self._file is not None:
@@ -111,7 +114,11 @@ class RunLogger:
         sys.stdout.flush()
 
     def close(self) -> None:
+        # Terminal for BOTH channels (unlike overall_done, which leaves
+        # the grammar path reopenable for a next run on the same logger):
+        # after close(), grammar writes and metric() are both no-ops.
         self._close_grammar_file()
+        self._output_path = None
         if self._metrics is not None:
             self._metrics.close()
             self._metrics = None

@@ -1,0 +1,26 @@
+"""XLA_FLAGS helpers shared by the virtual-device provisioning paths.
+
+XLA parses ``XLA_FLAGS`` exactly once, at the first backend
+initialization — so forcing a host-platform device count means editing
+the env var before that moment and restoring it right after (the
+mutation must never leak into later subprocesses doing real single-chip
+work; see ``__graft_entry__._try_ensure_devices``).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def device_flags_value(n_devices: int, flags: str | None = None) -> str:
+    """The XLA_FLAGS string with the host-device count forced to
+    ``n_devices``, preserving any other flags present."""
+    if flags is None:
+        flags = os.environ.get("XLA_FLAGS", "")
+    want = f"{_COUNT_FLAG}={n_devices}"
+    if _COUNT_FLAG in flags:
+        return re.sub(rf"{_COUNT_FLAG}=\d+", want, flags)
+    return (flags + " " + want).strip()

@@ -1,0 +1,160 @@
+"""Per-kernel timing + MFU accounting on the current device.
+
+Measures, at bench-relevant shapes, the three fused Pallas kernels
+(`fused_scores`, `fused_topk`, `fused_topk_ktiled`), the pure-XLA
+reference (`fused_scores_reference` + `lax.top_k`), a bare
+``C @ C.T`` matmul (the FLOP floor — anything above it is kernel
+overhead), and the device dispatch round-trip (the per-call floor —
+relevant on this box where the chip sits behind a tunnel).
+
+For every timing it derives achieved TFLOP/s (model FLOPs
+``2·N²·V``, the matmul chain's arithmetic — normalization/top-k adds
+O(N²·k) VPU work that is NOT counted, so the MXU utilisation figure is
+conservative) and MFU against the chip's bf16 peak. The kernels run
+f32 with ``precision=HIGHEST`` (integer path counts — SURVEY.md §7),
+which the MXU executes as multiple bf16 passes, so the *achievable*
+ceiling for this precision is peak/``F32_PASS_FACTOR``; both ratios are
+reported.
+
+Emits one JSON document (KERNELS_r03.json schema) on stdout; run
+``python scripts/kernel_bench.py [--out FILE] [--quick]`` as the only
+TPU client.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+# Published peak dense compute per chip, bf16 MXU. (v5e: 197 TFLOP/s;
+# v4: 275; v5p: 459.) Used only for the MFU denominator.
+_PEAK_BF16_TFLOPS = {
+    "TPU v5 lite": 197.0,
+    "TPU v4": 275.0,
+    "TPU v5": 459.0,
+}
+# precision=HIGHEST on f32 inputs runs the MXU in multi-pass mode
+# (bf16x6 on current generations): ~6 MXU passes per logical f32 MAC.
+F32_PASS_FACTOR = 6
+
+
+def _time(fn, reps: int = 5) -> dict:
+    """Median + spread of ``reps`` timed calls (after one warmup/compile
+    call). Each call blocks until the device result is ready."""
+    import jax
+
+    jax.block_until_ready(fn())  # compile + warm
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn())
+        times.append(time.perf_counter() - t0)
+    return {
+        "median_ms": statistics.median(times) * 1e3,
+        "min_ms": min(times) * 1e3,
+        "max_ms": max(times) * 1e3,
+        "reps": reps,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None, help="also write JSON here")
+    ap.add_argument("--quick", action="store_true", help="smallest shape only")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_pathsim_tpu.ops import pallas_kernels as pk
+
+    dev = jax.devices()[0]
+    kind = dev.device_kind
+    peak = next(
+        (v for k, v in _PEAK_BF16_TFLOPS.items() if kind.startswith(k)), None
+    )
+    result = {
+        "device": str(dev),
+        "device_kind": kind,
+        "platform": dev.platform,
+        "peak_bf16_tflops": peak,
+        "f32_pass_factor": F32_PASS_FACTOR,
+        "note": (
+            "flops counted = 2*N^2*V (matmul only); kernels run f32 "
+            "precision=HIGHEST => achievable ceiling is peak/f32_pass_factor"
+        ),
+        "dispatch_roundtrip": None,
+        "shapes": [],
+    }
+
+    # Per-call dispatch floor: a trivial jitted op, result fetched.
+    one = jnp.ones((8, 128), jnp.float32)
+    add = jax.jit(lambda x: x + 1.0)
+    result["dispatch_roundtrip"] = _time(lambda: add(one), reps=10)
+
+    shapes = [(8192, 384)] if args.quick else [(8192, 384), (32768, 384)]
+    key = jax.random.PRNGKey(0)
+    for n, v in shapes:
+        # Integer-valued C like the real half-chain factor (counts).
+        c = jax.random.randint(key, (n, v), 0, 3).astype(jnp.float32)
+        d = jnp.maximum(c.sum(axis=1), 1.0)
+        jax.block_until_ready((c, d))
+        flops = 2.0 * n * n * v
+
+        entries = {}
+        bare = jax.jit(
+            lambda x: jnp.matmul(
+                x, x.T, precision=jax.lax.Precision.HIGHEST
+            )
+        )
+        entries["xla_bare_matmul"] = _time(lambda: bare(c))
+        entries["xla_scores_reference"] = _time(
+            lambda: pk.fused_scores_reference(c, d)
+        )
+        xla_topk = jax.jit(
+            lambda x, dd: jax.lax.top_k(pk.fused_scores_reference(x, dd), 10)
+        )
+        entries["xla_scores_topk"] = _time(lambda: xla_topk(c, d))
+        entries["pallas_fused_scores"] = _time(lambda: pk.fused_scores(c, d))
+        entries["pallas_fused_topk"] = _time(
+            lambda: pk.fused_topk(c, d, k=10)
+        )
+        entries["pallas_fused_topk_ktiled"] = _time(
+            lambda: pk.fused_topk_ktiled(c, d, k=10)
+        )
+
+        for name, e in entries.items():
+            tflops = flops / (e["median_ms"] / 1e3) / 1e12
+            e["achieved_tflops"] = tflops
+            if peak:
+                e["mfu_vs_bf16_peak"] = tflops / peak
+                e["mfu_vs_f32_ceiling"] = tflops / (peak / F32_PASS_FACTOR)
+        result["shapes"].append(
+            {"n_authors": n, "v_width": v, "model_flops": flops,
+             "kernels": entries}
+        )
+        print(
+            f"# N={n} V={v}: " + ", ".join(
+                f"{k}={e['median_ms']:.1f}ms({e['achieved_tflops']:.1f}TF)"
+                for k, e in entries.items()
+            ),
+            file=sys.stderr, flush=True,
+        )
+
+    doc = json.dumps(result, indent=1)
+    print(doc, flush=True)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(doc + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -236,3 +236,69 @@ def test_multipath_rejects_env_rendezvous(dblp_small_path, capsys, monkeypatch):
     ])
     assert rc == 1
     assert "multi-metapath mode" in capsys.readouterr().err
+
+
+def test_platform_cpu_pin(dblp_small_path, tmp_path):
+    out = tmp_path / "o.log"
+    rc = main([
+        "--dataset", dblp_small_path, "--backend", "jax",
+        "--platform", "cpu",
+        "--source", "Didier Dubois", "--output", str(out), "--quiet",
+    ])
+    assert rc == 0
+    assert "Source author global walk: 3" in out.read_text()
+
+
+def test_platform_tpu_fails_cleanly_without_accelerator(dblp_small_path, capsys):
+    # Test processes are pinned to CPU (conftest), so --platform tpu must
+    # refuse rather than silently run on the host.
+    rc = main([
+        "--dataset", dblp_small_path, "--backend", "jax",
+        "--platform", "tpu", "--all-pairs", "--quiet",
+    ])
+    assert rc == 1
+    assert "no accelerator" in capsys.readouterr().err
+
+
+def test_sparse_knobs_require_sparse_backend(dblp_small_path, capsys):
+    rc = main([
+        "--dataset", dblp_small_path, "--backend", "jax",
+        "--tile-rows", "512", "--all-pairs", "--quiet",
+    ])
+    assert rc == 1
+    assert "jax-sparse" in capsys.readouterr().err
+
+
+def test_sparse_knobs_plumb_through(dblp_small_path, tmp_path, capsys):
+    # --tile-rows + --approx reach the backend: a tiny tile size forces
+    # the multi-tile streaming path on dblp_small.
+    out = tmp_path / "r.tsv"
+    rc = main([
+        "--dataset", dblp_small_path, "--backend", "jax-sparse",
+        "--tile-rows", "256", "--approx",
+        "--top-k", "2", "--ranking-out", str(out), "--quiet",
+    ])
+    assert rc == 0
+    assert "Ranked top-2 for all 770 sources" in capsys.readouterr().out
+    assert len(out.read_text().splitlines()) > 700
+
+
+def test_multihost_rejects_non_sharded_backend(dblp_small_path, capsys):
+    rc = main([
+        "--dataset", dblp_small_path, "--backend", "jax",
+        "--coordinator-address", "127.0.0.1:1", "--all-pairs", "--quiet",
+    ])
+    assert rc == 1
+    assert "jax-sharded" in capsys.readouterr().err
+
+
+def test_multihost_env_rejects_non_sharded_backend(
+    dblp_small_path, capsys, monkeypatch
+):
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "127.0.0.1:1")
+    rc = main([
+        "--dataset", dblp_small_path, "--backend", "numpy",
+        "--all-pairs", "--quiet",
+    ])
+    assert rc == 1
+    assert "jax-sharded" in capsys.readouterr().err
