@@ -26,14 +26,17 @@ import json
 import time
 
 
+import bench as _headline  # canonical shapes — keeps tiers comparable
+
+
 def parse_args(argv=None):
     p = argparse.ArgumentParser()
-    p.add_argument("--authors", type=int, default=8192)
-    p.add_argument("--papers", type=int, default=12_000)
-    p.add_argument("--venues", type=int, default=384)
+    p.add_argument("--authors", type=int, default=_headline.N_AUTHORS_CPU)
+    p.add_argument("--papers", type=int, default=_headline.N_PAPERS)
+    p.add_argument("--venues", type=int, default=_headline.N_VENUES)
     p.add_argument("--devices", type=int, default=8)
-    p.add_argument("--top-k", type=int, default=10)
-    p.add_argument("--repeats", type=int, default=3)
+    p.add_argument("--top-k", type=int, default=_headline.TOP_K)
+    p.add_argument("--repeats", type=int, default=_headline.REPS)
     p.add_argument(
         "--backends",
         default="jax,jax-sharded,jax-sparse",
@@ -72,8 +75,10 @@ def _ensure_devices(n: int) -> str:
 
 
 def bench_backend(name: str, hin, mp, k: int, repeats: int, n_devices: int):
-    """Best-of-``repeats`` wall-clock for a full rank-all top-k,
-    including the host fetch of the [N, k] winners."""
+    """Median-of-``repeats`` wall-clock (with min/max spread) for a full
+    rank-all top-k, including the host fetch of the [N, k] winners."""
+    import statistics
+
     from distributed_pathsim_tpu.backends.base import create_backend
 
     options = {}
@@ -92,7 +97,7 @@ def bench_backend(name: str, hin, mp, k: int, repeats: int, n_devices: int):
         t0 = time.perf_counter()
         run()
         times.append(time.perf_counter() - t0)
-    return min(times)
+    return statistics.median(times), min(times), max(times)
 
 
 def main(argv=None) -> None:
@@ -107,7 +112,7 @@ def main(argv=None) -> None:
     pairs = float(args.authors) * (args.authors - 1)
 
     for name in [b.strip() for b in args.backends.split(",") if b.strip()]:
-        best = bench_backend(
+        med, tmin, tmax = bench_backend(
             name, hin, mp, k=args.top_k, repeats=args.repeats,
             n_devices=args.devices,
         )
@@ -123,10 +128,15 @@ def main(argv=None) -> None:
                         f"author_pairs_per_sec_{name}_{scale}_authors_"
                         f"top{args.top_k}_{platform}{n_dev}dev"
                     ),
-                    "value": pairs / best,
+                    # min-of-reps, same rationale as bench.py: robust to
+                    # external load on a shared box; spread stays visible
+                    "value": pairs / tmin,
                     "unit": "pairs/sec",
                     "vs_baseline": None,  # CPU mesh: no honest TPU ratio
-                    "seconds": best,
+                    "seconds_min": tmin,
+                    "seconds_median": med,
+                    "seconds_max": tmax,
+                    "reps": args.repeats,
                 }
             ),
             flush=True,

@@ -166,7 +166,13 @@ class JaxDenseBackend(PathSimBackend):
         if not self._symmetric:
             raise ValueError("topk fast path requires a symmetric metapath")
         c, rowsums = self._half()
-        if self.use_pallas and not pk.fits_vmem(c.shape[1]):
+        if self.use_pallas and k <= pk._CAND:
+            # Fastest path: candidate extraction + XLA reduce (handles
+            # any V internally); measured ~3x the single-pass fold.
+            vals, idxs = pk.fused_topk_twopass(
+                c, rowsums, k=k, mask_self=mask_self
+            )
+        elif self.use_pallas and not pk.fits_vmem(c.shape[1]):
             vals, idxs = pk.fused_topk_ktiled(c, rowsums, k=k, mask_self=mask_self)
         elif self.use_pallas:
             vals, idxs = pk.fused_topk(c, rowsums, k=k, mask_self=mask_self)

@@ -21,6 +21,7 @@ from ..ops import sparse as sp
 from ..parallel.mesh import make_mesh
 from ..parallel.multihost import distributed_first_block, make_hybrid_mesh
 from ..parallel.sharded import (
+    choose_allpairs_strategy,
     sharded_chain_outputs,
     sharded_topk,
 )
@@ -48,7 +49,7 @@ class JaxShardedBackend(PathSimBackend):
         hin,
         metapath,
         n_devices: int | None = None,
-        allpairs_strategy: str = "allgather",
+        allpairs_strategy: str = "auto",
         dtype=jnp.float32,
         **options,
     ):
@@ -72,8 +73,14 @@ class JaxShardedBackend(PathSimBackend):
             self.mesh = make_hybrid_mesh(tp=1)
         else:
             self.mesh = make_mesh(n_devices)
-        self.allpairs_strategy = allpairs_strategy
         self.n = hin.type_size(metapath.source_type)
+        if allpairs_strategy == "auto":
+            # C is [N, V] with V the palindrome midpoint type's size
+            v = hin.type_size(metapath.node_types[len(metapath.steps) // 2])
+            allpairs_strategy = choose_allpairs_strategy(
+                self.n, v, self.mesh.shape["dp"], np.dtype(dtype).itemsize
+            )
+        self.allpairs_strategy = allpairs_strategy
 
         # Sparse-first: fold the half-chain to COO on host (O(nnz)); the
         # dense [N, V] factor C is then assembled HOST-LOCALLY — each

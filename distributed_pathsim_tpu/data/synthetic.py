@@ -130,51 +130,59 @@ def _range_index(node_type: str, size: int, materialize: bool) -> TypeIndex:
 
 
 def write_gexf(hin: EncodedHIN, path: str) -> None:
-    """Write a (small) EncodedHIN as GEXF 1.2 in the reference's dialect
-    (NetworkX-2.0-style: node_type as node attvalue 0, relationship as edge
-    attvalue titled 'label')."""
+    """Write an EncodedHIN as GEXF 1.2 in the reference's dialect
+    (NetworkX-2.0-style: node_type as node attvalue 0, relationship as
+    edge attvalue titled 'label'). Streams to the file — dblp_large-scale
+    graphs (millions of nodes, ~1 GB of XML) must not be built as one
+    in-memory string."""
     from xml.sax.saxutils import quoteattr
 
-    lines = [
-        "<?xml version='1.0' encoding='utf-8'?>",
-        '<gexf version="1.2" xmlns="http://www.gexf.net/1.2draft">',
-        f'  <graph defaultedgetype="directed" mode="static" name={quoteattr(hin.name)}>',
-        '    <attributes class="edge" mode="static">',
-        '      <attribute id="1" title="label" type="string" />',
-        "    </attributes>",
-        '    <attributes class="node" mode="static">',
-        '      <attribute id="0" title="node_type" type="string" />',
-        "    </attributes>",
-        "    <nodes>",
-    ]
-    for t in hin.schema.node_types:
-        idx = hin.indices[t]
-        n = idx.size
-        if n and not idx.ids:
-            raise ValueError(
-                "write_gexf needs materialized ids; build the HIN with "
-                "materialize_ids=True"
-            )
-        for i in range(n):
-            lines.append(
-                f"      <node id={quoteattr(idx.ids[i])} label={quoteattr(idx.labels[i])}>"
-                f"<attvalues><attvalue for=\"0\" value={quoteattr(t)} /></attvalues></node>"
-            )
-    lines.append("    </nodes>")
-    lines.append("    <edges>")
-    k = 0
-    for rel, b in hin.blocks.items():
-        src_ids = hin.indices[b.src_type].ids
-        dst_ids = hin.indices[b.dst_type].ids
-        for r, c in zip(b.rows.tolist(), b.cols.tolist()):
-            lines.append(
-                f'      <edge id="{k}" source={quoteattr(src_ids[r])} '
-                f"target={quoteattr(dst_ids[c])}>"
-                f"<attvalues><attvalue for=\"1\" value={quoteattr(rel)} /></attvalues></edge>"
-            )
-            k += 1
-    lines.append("    </edges>")
-    lines.append("  </graph>")
-    lines.append("</gexf>")
     with open(path, "w", encoding="utf-8") as f:
-        f.write("\n".join(lines) + "\n")
+        w = f.write
+        w("<?xml version='1.0' encoding='utf-8'?>\n")
+        w('<gexf version="1.2" xmlns="http://www.gexf.net/1.2draft">\n')
+        w(
+            f'  <graph defaultedgetype="directed" mode="static" '
+            f"name={quoteattr(hin.name)}>\n"
+        )
+        w('    <attributes class="edge" mode="static">\n')
+        w('      <attribute id="1" title="label" type="string" />\n')
+        w("    </attributes>\n")
+        w('    <attributes class="node" mode="static">\n')
+        w('      <attribute id="0" title="node_type" type="string" />\n')
+        w("    </attributes>\n")
+        w("    <nodes>\n")
+        for t in hin.schema.node_types:
+            idx = hin.indices[t]
+            n = idx.size
+            if n and not idx.ids:
+                raise ValueError(
+                    "write_gexf needs materialized ids; build the HIN with "
+                    "materialize_ids=True"
+                )
+            tq = quoteattr(t)
+            for i in range(n):
+                w(
+                    f"      <node id={quoteattr(idx.ids[i])} "
+                    f"label={quoteattr(idx.labels[i])}>"
+                    f'<attvalues><attvalue for="0" value={tq} />'
+                    f"</attvalues></node>\n"
+                )
+        w("    </nodes>\n")
+        w("    <edges>\n")
+        k = 0
+        for rel, b in hin.blocks.items():
+            src_ids = hin.indices[b.src_type].ids
+            dst_ids = hin.indices[b.dst_type].ids
+            relq = quoteattr(rel)
+            for r, c in zip(b.rows.tolist(), b.cols.tolist()):
+                w(
+                    f'      <edge id="{k}" source={quoteattr(src_ids[r])} '
+                    f"target={quoteattr(dst_ids[c])}>"
+                    f'<attvalues><attvalue for="1" value={relq} />'
+                    f"</attvalues></edge>\n"
+                )
+                k += 1
+        w("    </edges>\n")
+        w("  </graph>\n")
+        w("</gexf>\n")

@@ -16,8 +16,26 @@ from .driver import PathSimDriver
 from .ops.metapath import MetaPath, compile_metapath
 
 
-def load_dataset(path: str) -> EncodedHIN:
-    graph = read_gexf(path)
+def load_dataset(path: str, use_native: bool | None = None) -> EncodedHIN:
+    """GEXF → EncodedHIN. ``use_native`` mirrors read_gexf's tri-state:
+    None prefers the C++ single-pass parse+encode with clean fallback,
+    False forces the exact Python pipeline (the escape hatch if the
+    native path ever misbehaves), True requires native."""
+    if use_native is not False:
+        try:
+            from .native import gexf_native
+
+            if gexf_native.available():
+                # Parse + encode in one native pass: no per-edge Python
+                # objects (the marshalling, not the XML, dominates at
+                # dblp_large scale — see scripts/parser_bench.py artifact).
+                return gexf_native.read_gexf_encoded(path)
+            if use_native is True:
+                raise RuntimeError("native GEXF loader requested but unavailable")
+        except OSError:  # toolchain/loader trouble: the Python path is exact
+            if use_native is True:
+                raise
+    graph = read_gexf(path, use_native=False if use_native is False else None)
     return encode_hin(graph)
 
 

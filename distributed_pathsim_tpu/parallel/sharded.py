@@ -34,6 +34,31 @@ from .mesh import pad_to_multiple
 from .ring import ring_allpairs_rowblock, ring_topk_rowblock
 
 
+# all_gather materializes every peer's C block on every device — fast
+# (one fused collective, maximal overlap) until the gathered [N_pad, V]
+# factor starts to crowd HBM; the ppermute ring keeps only 2 peer
+# blocks live at any time at the cost of D-1 dependent steps. Crossover
+# measured on the virtual mesh (SHARDED_SCALING_r03.json): allgather
+# wins at every size that fits; the ring exists for the sizes that
+# don't. Budget: gathered C + local M row-block + working set, well
+# under a v5e's 16 GB HBM.
+_ALLGATHER_C_MAX_BYTES = 2 << 30
+
+
+def choose_allpairs_strategy(
+    n_rows: int, v_width: int, n_devices: int, itemsize: int = 4
+) -> str:
+    """Pick ``allgather`` vs ``ring`` for the all-pairs product.
+
+    ``allgather`` until the gathered C ([N_pad, V] on EVERY device)
+    exceeds the HBM budget; ``ring`` beyond. The fold/psum/top-k phases
+    are identical under either choice.
+    """
+    n_pad = pad_to_multiple(n_rows, n_devices)
+    gathered_bytes = n_pad * v_width * itemsize
+    return "allgather" if gathered_bytes <= _ALLGATHER_C_MAX_BYTES else "ring"
+
+
 def shard_first_block_rows(
     first: np.ndarray, mesh: Mesh, axis: str = "dp"
 ) -> jax.Array:

@@ -14,6 +14,24 @@ import re
 
 _COUNT_FLAG = "--xla_force_host_platform_device_count"
 
+# One cache location for every harness/script: remote compiles through
+# the TPU tunnel cost tens of seconds per program, and the bench child's
+# alarm budget assumes warm repeats.
+COMPILE_CACHE_DIR = "/root/.jax_cache"
+
+
+def enable_compile_cache() -> None:
+    """Best-effort persistent compilation cache (no-op on jax versions
+    without the knobs — the cache is an optimization, never a
+    requirement)."""
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", COMPILE_CACHE_DIR)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        pass
+
 
 def device_flags_value(n_devices: int, flags: str | None = None) -> str:
     """The XLA_FLAGS string with the host-device count forced to

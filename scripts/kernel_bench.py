@@ -1,24 +1,34 @@
 """Per-kernel timing + MFU accounting on the current device.
 
-Measures, at bench-relevant shapes, the three fused Pallas kernels
-(`fused_scores`, `fused_topk`, `fused_topk_ktiled`), the pure-XLA
-reference (`fused_scores_reference` + `lax.top_k`), a bare
-``C @ C.T`` matmul (the FLOP floor — anything above it is kernel
-overhead), and the device dispatch round-trip (the per-call floor —
-relevant on this box where the chip sits behind a tunnel).
+Measures, at bench-relevant shapes, the fused Pallas kernels
+(`fused_scores`, `fused_topk`), the pure-XLA reference
+(`fused_scores_reference` [+ `lax.top_k`]), and the device dispatch
+round-trip, then derives achieved TFLOP/s (model FLOPs ``2·N²·V`` —
+matmul work only, so the figure is conservative for the top-k kernels)
+and MFU against the chip's bf16 peak. The kernels run f32 with
+``precision=HIGHEST`` (integer path counts — SURVEY.md §7), which the
+MXU executes as multiple bf16 passes, so the *achievable* ceiling for
+this precision is ``peak / F32_PASS_FACTOR``; both ratios are reported.
 
-For every timing it derives achieved TFLOP/s (model FLOPs
-``2·N²·V``, the matmul chain's arithmetic — normalization/top-k adds
-O(N²·k) VPU work that is NOT counted, so the MXU utilisation figure is
-conservative) and MFU against the chip's bf16 peak. The kernels run
-f32 with ``precision=HIGHEST`` (integer path counts — SURVEY.md §7),
-which the MXU executes as multiple bf16 passes, so the *achievable*
-ceiling for this precision is peak/``F32_PASS_FACTOR``; both ratios are
-reported.
+Timing methodology (load-bearing on this box, where the chip sits
+behind a single-client tunnel):
+
+- Per-call RPC latency is ~70 ms and a host fetch adds ~70 ms, so a
+  single timed call measures the tunnel, not the kernel. Worse,
+  repeated calls of the same jitted function with the same arguments
+  return absurdly fast (result caching in the relay), so naive
+  ``block_until_ready`` loops are garbage.
+- Each kernel is therefore timed as an in-jit ``lax.fori_loop`` of R
+  calls chained through a scalar data dependency (input perturbed by
+  ``s·1e-38``, carry folded from the output), with the R=R1 and R=R2
+  totals differenced: per_call = (T(R2) − T(R1)) / (R2 − R1). The
+  carry folds ``jnp.max`` of the full output so XLA cannot
+  dead-code-eliminate or slice-simplify the computation.
 
 Emits one JSON document (KERNELS_r03.json schema) on stdout; run
 ``python scripts/kernel_bench.py [--out FILE] [--quick]`` as the only
-TPU client.
+TPU client, never under an external ``timeout`` (a signalled client
+wedges the tunnel — see bench.py's protocol).
 """
 
 from __future__ import annotations
@@ -40,26 +50,44 @@ _PEAK_BF16_TFLOPS = {
     "TPU v4": 275.0,
     "TPU v5": 459.0,
 }
-# precision=HIGHEST on f32 inputs runs the MXU in multi-pass mode
-# (bf16x6 on current generations): ~6 MXU passes per logical f32 MAC.
+# precision=HIGHEST on f32 inputs runs the MXU in multi-pass mode:
+# ~6 MXU passes per logical f32 MAC on current generations.
 F32_PASS_FACTOR = 6
 
 
-def _time(fn, reps: int = 5) -> dict:
-    """Median + spread of ``reps`` timed calls (after one warmup/compile
-    call). Each call blocks until the device result is ready."""
-    import jax
-
-    jax.block_until_ready(fn())  # compile + warm
+def _median_total(fn, c, d, reps: int) -> float:
+    np.asarray(fn(c, d))  # compile + warm (fetch forces real sync)
     times = []
     for _ in range(reps):
         t0 = time.perf_counter()
-        jax.block_until_ready(fn())
+        np.asarray(fn(c, d))
         times.append(time.perf_counter() - t0)
+    return statistics.median(times)
+
+
+def _per_call(scalar_fn, c, d, r1: int, r2: int, reps: int) -> dict:
+    """Differenced in-jit loop timing (see module docstring)."""
+    import jax
+    import jax.numpy as jnp
+
+    def make(r):
+        @jax.jit
+        def run(cc, dd):
+            def body(_, s):
+                return s + scalar_fn(cc + s * 1e-38, dd) * 0.5
+
+            return jax.lax.fori_loop(0, r, body, jnp.float32(0.0))
+
+        return run
+
+    t1 = _median_total(make(r1), c, d, reps)
+    t2 = _median_total(make(r2), c, d, reps)
     return {
-        "median_ms": statistics.median(times) * 1e3,
-        "min_ms": min(times) * 1e3,
-        "max_ms": max(times) * 1e3,
+        "per_call_ms": (t2 - t1) / (r2 - r1) * 1e3,
+        "loop_r1": r1,
+        "loop_r2": r2,
+        "t_r1_ms": t1 * 1e3,
+        "t_r2_ms": t2 * 1e3,
         "reps": reps,
     }
 
@@ -71,6 +99,10 @@ def main() -> int:
     args = ap.parse_args()
 
     import jax
+
+    from distributed_pathsim_tpu.utils.xla_flags import enable_compile_cache
+
+    enable_compile_cache()
     import jax.numpy as jnp
 
     from distributed_pathsim_tpu.ops import pallas_kernels as pk
@@ -88,16 +120,23 @@ def main() -> int:
         "f32_pass_factor": F32_PASS_FACTOR,
         "note": (
             "flops counted = 2*N^2*V (matmul only); kernels run f32 "
-            "precision=HIGHEST => achievable ceiling is peak/f32_pass_factor"
+            "precision=HIGHEST => achievable ceiling is "
+            "peak/f32_pass_factor; per_call_ms from differenced in-jit "
+            "fori_loop (tunnel-latency-proof, see scripts/kernel_bench.py)"
         ),
-        "dispatch_roundtrip": None,
+        "dispatch_roundtrip_ms": None,
         "shapes": [],
     }
 
-    # Per-call dispatch floor: a trivial jitted op, result fetched.
+    # Per-call dispatch+fetch floor: trivial eager op, result fetched.
     one = jnp.ones((8, 128), jnp.float32)
-    add = jax.jit(lambda x: x + 1.0)
-    result["dispatch_roundtrip"] = _time(lambda: add(one), reps=10)
+    np.asarray(one + 1.0)
+    rts = []
+    for _ in range(10):
+        t0 = time.perf_counter()
+        np.asarray(one + 1.0)
+        rts.append(time.perf_counter() - t0)
+    result["dispatch_roundtrip_ms"] = statistics.median(rts) * 1e3
 
     shapes = [(8192, 384)] if args.quick else [(8192, 384), (32768, 384)]
     key = jax.random.PRNGKey(0)
@@ -105,47 +144,42 @@ def main() -> int:
         # Integer-valued C like the real half-chain factor (counts).
         c = jax.random.randint(key, (n, v), 0, 3).astype(jnp.float32)
         d = jnp.maximum(c.sum(axis=1), 1.0)
-        jax.block_until_ready((c, d))
+        np.asarray(d)
         flops = 2.0 * n * n * v
+        heavy = n >= 32768
 
+        kernels = {
+            "xla_scores_reference": lambda cc, dd: jnp.max(
+                pk.fused_scores_reference(cc, dd)
+            ),
+            "xla_scores_topk": lambda cc, dd: jnp.max(
+                jax.lax.top_k(pk.fused_scores_reference(cc, dd), 10)[0]
+            ),
+            "pallas_fused_scores": lambda cc, dd: jnp.max(
+                pk.fused_scores(cc, dd)
+            ),
+            "pallas_fused_topk": lambda cc, dd: jnp.max(
+                pk.fused_topk(cc, dd, k=10)[0]
+            ),
+        }
         entries = {}
-        bare = jax.jit(
-            lambda x: jnp.matmul(
-                x, x.T, precision=jax.lax.Precision.HIGHEST
-            )
-        )
-        entries["xla_bare_matmul"] = _time(lambda: bare(c))
-        entries["xla_scores_reference"] = _time(
-            lambda: pk.fused_scores_reference(c, d)
-        )
-        xla_topk = jax.jit(
-            lambda x, dd: jax.lax.top_k(pk.fused_scores_reference(x, dd), 10)
-        )
-        entries["xla_scores_topk"] = _time(lambda: xla_topk(c, d))
-        entries["pallas_fused_scores"] = _time(lambda: pk.fused_scores(c, d))
-        entries["pallas_fused_topk"] = _time(
-            lambda: pk.fused_topk(c, d, k=10)
-        )
-        entries["pallas_fused_topk_ktiled"] = _time(
-            lambda: pk.fused_topk_ktiled(c, d, k=10)
-        )
-
-        for name, e in entries.items():
-            tflops = flops / (e["median_ms"] / 1e3) / 1e12
+        for name, fn in kernels.items():
+            slow = heavy and name in ("xla_scores_topk", "pallas_fused_topk")
+            e = _per_call(fn, c, d, r1=1, r2=3 if slow else 6, reps=3)
+            tflops = flops / (e["per_call_ms"] / 1e3) / 1e12
             e["achieved_tflops"] = tflops
             if peak:
                 e["mfu_vs_bf16_peak"] = tflops / peak
                 e["mfu_vs_f32_ceiling"] = tflops / (peak / F32_PASS_FACTOR)
+            entries[name] = e
+            print(
+                f"# N={n} {name}: {e['per_call_ms']:.1f}ms "
+                f"({tflops:.1f} TF/s)",
+                file=sys.stderr, flush=True,
+            )
         result["shapes"].append(
             {"n_authors": n, "v_width": v, "model_flops": flops,
              "kernels": entries}
-        )
-        print(
-            f"# N={n} V={v}: " + ", ".join(
-                f"{k}={e['median_ms']:.1f}ms({e['achieved_tflops']:.1f}TF)"
-                for k, e in entries.items()
-            ),
-            file=sys.stderr, flush=True,
         )
 
     doc = json.dumps(result, indent=1)

@@ -1,0 +1,122 @@
+"""Large-file GEXF loader evidence (PARSER_r03).
+
+The reference lost its large dataset (`/root/reference/.MISSING_LARGE_BLOBS`,
+referenced at `DPathSim_APVPA.py:141`), so the loader's scaling claims had
+no artifact. This script regenerates a dblp_large-scale GEXF with
+``data/synthetic.write_gexf`` (same reference dialect the loaders parse),
+reads it with BOTH parsers — the streaming-iterparse Python loader
+(`data/gexf.py`) and the native C++ single-pass parser
+(`native/gexf_fast.cpp`) — asserts their outputs are identical element
+for element, and records wall-clock for each.
+
+Usage: python scripts/parser_bench.py [--nodes 2000000] [--out FILE]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, ".")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=2_000_000,
+                    help="approximate total node count (A+P+V)")
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--keep", default=None,
+                    help="keep the generated GEXF at this path")
+    args = ap.parse_args()
+
+    from distributed_pathsim_tpu.data.gexf import read_gexf as read_py
+    from distributed_pathsim_tpu.data.synthetic import synthetic_hin, write_gexf
+    from distributed_pathsim_tpu.native import gexf_native
+
+    if not gexf_native.available():
+        print("native parser unavailable (no toolchain?)", file=sys.stderr)
+        return 1
+
+    # dblp_small's shape, scaled: papers ≈ 1.3×authors, venues ≈ papers/12
+    n_authors = int(args.nodes / 2.35)
+    n_papers = int(1.3 * n_authors)
+    n_venues = max(64, n_papers // 250)
+    t0 = time.perf_counter()
+    hin = synthetic_hin(
+        n_authors, n_papers, n_venues, seed=7, materialize_ids=True
+    )
+    t_gen = time.perf_counter() - t0
+
+    path = args.keep or os.path.join(
+        tempfile.gettempdir(), "dblp_large_synth.gexf"
+    )
+    t0 = time.perf_counter()
+    write_gexf(hin, path)
+    t_write = time.perf_counter() - t0
+    size = os.path.getsize(path)
+
+    # Path A (pure Python): iterparse → HINGraph → encode_hin.
+    from distributed_pathsim_tpu.data.encode import encode_hin
+
+    t0 = time.perf_counter()
+    g_py = read_py(path, use_native=False)
+    t_py_parse = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    hin_py = encode_hin(g_py)
+    t_py_encode = time.perf_counter() - t0
+
+    # Path B (native strings): C++ parse → HINGraph (marshalling-bound).
+    t0 = time.perf_counter()
+    g_native = gexf_native.read_gexf(path)
+    t_native_parse = time.perf_counter() - t0
+
+    # Path C (native encoded, the product path at scale): C++ parse +
+    # C++ encode → EncodedHIN, no per-edge Python objects.
+    t0 = time.perf_counter()
+    hin_native = gexf_native.read_gexf_encoded(path)
+    t_native_encoded = time.perf_counter() - t0
+
+    assert g_py.vertices == g_native.vertices, "vertex lists differ"
+    assert g_py.edges == g_native.edges, "edge lists differ"
+    assert g_py.name == g_native.name
+    assert hin_native.schema.node_types == hin_py.schema.node_types
+    for t in hin_py.schema.node_types:
+        assert hin_native.indices[t].ids == hin_py.indices[t].ids
+    for rel, wb in hin_py.blocks.items():
+        gb = hin_native.blocks[rel]
+        assert gb.shape == wb.shape
+        assert (gb.rows == wb.rows).all() and (gb.cols == wb.cols).all()
+
+    py_total = t_py_parse + t_py_encode
+    result = {
+        "nodes": len(g_py.vertices),
+        "edges": len(g_py.edges),
+        "gexf_bytes": size,
+        "generate_s": t_gen,
+        "write_s": t_write,
+        "python_parse_s": t_py_parse,
+        "python_encode_s": t_py_encode,
+        "python_total_to_encoded_s": py_total,
+        "native_parse_strings_s": t_native_parse,
+        "native_parse_and_encode_s": t_native_encoded,
+        "native_speedup_to_encoded": py_total / t_native_encoded,
+        "python_mb_per_s": size / 1e6 / py_total,
+        "native_mb_per_s": size / 1e6 / t_native_encoded,
+        "outputs_identical": True,
+    }
+    if not args.keep:
+        os.unlink(path)
+    doc = json.dumps(result, indent=1)
+    print(doc, flush=True)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(doc + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

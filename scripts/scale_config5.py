@@ -10,14 +10,15 @@ and records the evidence: wall-clock per phase, pairs/sec, peak host
 RSS, checkpoint resume counts. Emits ONE JSON line and (with --out)
 writes it to an artifact file.
 
-Memory profile at 1M authors, V=64, tile_rows=8192 (all measured, see
-SCALE_r02.json): COO fold ~hundreds of MB, one [8192, 8192] f32 score
-tile at a time on device, [N, 10] winners on host — neither the N×P
-adjacency, the N×V dense C, nor any N×N block ever materializes.
+Memory profile at 1M authors, V=64, tile_rows=8192 (all measured —
+committed artifact: SCALE_r03.json at the repo root): COO fold
+~hundreds of MB, one [8192, 8192] f32 score tile at a time on device,
+[N, 10] winners on host — neither the N×P adjacency, the N×V dense C,
+nor any N×N block ever materializes.
 
 Usage:
   python scripts/scale_config5.py --authors 1048576 --papers 5242880 \
-      --venues 64 --checkpoint-dir /tmp/scale_ck --out SCALE_r02.json
+      --venues 64 --checkpoint-dir /tmp/scale_ck --out SCALE_r03.json
 A killed run (crash, preemption) resumes: rerun the same command; the
 artifact's "resumed_row_tiles" counts the units skipped on restart.
 """

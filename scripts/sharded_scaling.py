@@ -1,0 +1,149 @@
+"""Weak-scaling evidence for the sharded tier (SHARDED_SCALING_r03).
+
+Sweeps the virtual CPU mesh at 1/2/4/8 devices with ROWS PER DEVICE
+HELD CONSTANT (weak scaling: perfect behavior = flat wall-clock as
+devices and problem size grow together), timing each phase separately:
+
+- fold:      local half-chain fold + column-total psum + row sums
+             (``sharded_chain_outputs(want_m=False)``)
+- allgather: all-pairs M via ``all_gather`` of C (delta over fold)
+- ring:      all-pairs M via the ``ppermute`` ring (delta over fold)
+- topk:      distributed streaming top-k over the ring
+
+Caveat printed into the artifact: virtual CPU devices share one
+machine's memory bandwidth, so collectives are memcpy-speed and the
+absolute numbers are NOT TPU predictions; what the sweep shows is the
+scaling SHAPE (how close to flat the weak-scaling curve stays) and the
+allgather/ring crossover used by ``choose_allpairs_strategy``.
+
+Usage: python scripts/sharded_scaling.py [--rows-per-device N] [--out F]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+
+def _provision(n: int) -> None:
+    import os
+
+    from distributed_pathsim_tpu.utils.xla_flags import device_flags_value
+
+    os.environ["XLA_FLAGS"] = device_flags_value(n)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def _timed(fn, reps: int = 5) -> float:
+    import jax
+
+    jax.block_until_ready(fn())  # compile
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn())
+        ts.append(time.perf_counter() - t0)
+    return statistics.median(ts)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows-per-device", type=int, default=2048)
+    ap.add_argument("--papers", type=int, default=24_000)
+    ap.add_argument("--venues", type=int, default=384)
+    ap.add_argument("--top-k", type=int, default=10)
+    ap.add_argument("--devices", default="1,2,4,8")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    devices = [int(d) for d in args.devices.split(",")]
+    _provision(max(devices))
+
+    import jax
+
+    from distributed_pathsim_tpu.backends.base import create_backend
+    from distributed_pathsim_tpu.data.synthetic import synthetic_hin
+    from distributed_pathsim_tpu.ops.metapath import compile_metapath
+    from distributed_pathsim_tpu.parallel.sharded import (
+        choose_allpairs_strategy,
+        sharded_chain_outputs,
+        sharded_topk,
+    )
+
+    result = {
+        "mode": "weak_scaling",
+        "rows_per_device": args.rows_per_device,
+        "papers_per_device_scaled": True,
+        "venues": args.venues,
+        "platform": "cpu_virtual_devices",
+        "caveat": (
+            "virtual CPU devices share one machine's memory bandwidth; "
+            "absolute times are not TPU predictions — the scaling shape "
+            "and the allgather/ring comparison are the signal"
+        ),
+        "points": [],
+    }
+
+    for n_dev in devices:
+        n = args.rows_per_device * n_dev
+        papers = args.papers * n_dev // max(devices)
+        hin = synthetic_hin(n, max(papers, 2 * n), args.venues, seed=42)
+        mp = compile_metapath("APVPA", hin.schema)
+        backend = create_backend("jax-sharded", hin, mp, n_devices=n_dev)
+        first, mesh = backend._first, backend.mesh
+
+        t_fold = _timed(
+            lambda: sharded_chain_outputs(
+                first, (), mesh=mesh, want_m=False
+            )[1]
+        )
+        t_ag = _timed(
+            lambda: sharded_chain_outputs(
+                first, (), mesh=mesh, allpairs_strategy="allgather"
+            )[0]
+        )
+        t_ring = _timed(
+            lambda: sharded_chain_outputs(
+                first, (), mesh=mesh, allpairs_strategy="ring"
+            )[0]
+        )
+        t_topk = _timed(
+            lambda: sharded_topk(
+                first, (), mesh=mesh, k=args.top_k, n_true=n
+            )
+        )
+        point = {
+            "n_devices": n_dev,
+            "n_authors": n,
+            "fold_s": t_fold,
+            "allpairs_allgather_s": t_ag,
+            "allpairs_ring_s": t_ring,
+            "allgather_delta_s": t_ag - t_fold,
+            "ring_delta_s": t_ring - t_fold,
+            "topk_ring_s": t_topk,
+            "pairs_per_sec_topk": float(n) * (n - 1) / t_topk,
+            "chosen_strategy": choose_allpairs_strategy(
+                n, args.venues, n_dev
+            ),
+        }
+        result["points"].append(point)
+        print(f"# {json.dumps(point)}", file=sys.stderr, flush=True)
+        del backend, first
+
+    doc = json.dumps(result, indent=1)
+    print(doc, flush=True)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(doc + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

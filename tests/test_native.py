@@ -1,5 +1,6 @@
 """Native (C++) GEXF parser vs the Python parser — must be identical."""
 
+import numpy as np
 import pytest
 
 from distributed_pathsim_tpu.data.gexf import _read_gexf_python, read_gexf
@@ -184,3 +185,115 @@ def test_coo_spgemm_empty_result():
     )
     got = coo_native.coo_matmul_summed(a, b)
     assert got.rows.shape == (0,) and got.shape == (2, 4)
+
+
+def test_native_encoded_matches_python_pipeline(dblp_small_path):
+    """read_gexf_encoded must equal encode_hin(read_gexf(...)) in every
+    observable: type order, per-type ids/labels/index maps, relationship
+    signatures, COO blocks, graph name."""
+    from distributed_pathsim_tpu.data.encode import encode_hin
+    from distributed_pathsim_tpu.data.gexf import read_gexf
+    from distributed_pathsim_tpu.native import gexf_native
+
+    if not gexf_native.available():
+        pytest.skip("native parser unavailable")
+    want = encode_hin(read_gexf(dblp_small_path, use_native=False))
+    got = gexf_native.read_gexf_encoded(dblp_small_path)
+
+    assert got.name == want.name
+    assert got.schema.node_types == want.schema.node_types
+    assert dict(got.schema.relations) == dict(want.schema.relations)
+    for t in want.schema.node_types:
+        assert got.indices[t].ids == want.indices[t].ids
+        assert got.indices[t].labels == want.indices[t].labels
+        assert got.indices[t].index_of == want.indices[t].index_of
+    assert list(got.blocks) == list(want.blocks)
+    for rel in want.blocks:
+        gb, wb = got.blocks[rel], want.blocks[rel]
+        assert gb.shape == wb.shape
+        assert (gb.src_type, gb.dst_type) == (wb.src_type, wb.dst_type)
+        np.testing.assert_array_equal(gb.rows, wb.rows)
+        np.testing.assert_array_equal(gb.cols, wb.cols)
+
+
+def test_native_encoded_duplicate_and_error_semantics(tmp_path):
+    """Duplicate node ids: every occurrence indexed, last wins for edge
+    resolution; missing endpoints and mixed signatures are rejected with
+    the Python pipeline's messages."""
+    from distributed_pathsim_tpu.data.encode import encode_hin
+    from distributed_pathsim_tpu.data.gexf import read_gexf
+    from distributed_pathsim_tpu.native import gexf_native
+
+    if not gexf_native.available():
+        pytest.skip("native parser unavailable")
+
+    def gexf(nodes, edges):
+        lines = [
+            "<?xml version='1.0' encoding='utf-8'?>",
+            '<gexf version="1.2"><graph name="t">',
+            '<attributes class="node" mode="static">'
+            '<attribute id="0" title="node_type" type="string" /></attributes>',
+            '<attributes class="edge" mode="static">'
+            '<attribute id="1" title="label" type="string" /></attributes>',
+            "<nodes>",
+        ]
+        for nid, typ in nodes:
+            lines.append(
+                f'<node id="{nid}" label="{nid}"><attvalues>'
+                f'<attvalue for="0" value="{typ}" /></attvalues></node>'
+            )
+        lines.append("</nodes><edges>")
+        for k, (s, d, r) in enumerate(edges):
+            lines.append(
+                f'<edge id="{k}" source="{s}" target="{d}"><attvalues>'
+                f'<attvalue for="1" value="{r}" /></attvalues></edge>'
+            )
+        lines.append("</edges></graph></gexf>")
+        p = tmp_path / "t.gexf"
+        p.write_text("\n".join(lines))
+        return str(p)
+
+    # duplicate id "a1" (same type): two index entries, edges resolve to
+    # the LAST occurrence — compare against the Python pipeline.
+    path = gexf(
+        [("a1", "author"), ("p1", "paper"), ("a1", "author")],
+        [("a1", "p1", "author_of")],
+    )
+    want = encode_hin(read_gexf(path, use_native=False))
+    got = gexf_native.read_gexf_encoded(path)
+    assert got.indices["author"].ids == want.indices["author"].ids
+    np.testing.assert_array_equal(
+        got.blocks["author_of"].rows, want.blocks["author_of"].rows
+    )
+    assert got.blocks["author_of"].rows[0] == 1  # last occurrence
+
+    # missing endpoint
+    path = gexf([("a1", "author")], [("a1", "ghost", "author_of")])
+    with pytest.raises(ValueError, match="has no vertex entry"):
+        gexf_native.read_gexf_encoded(path)
+
+    # mixed signature
+    path = gexf(
+        [("a1", "author"), ("p1", "paper"), ("v1", "venue")],
+        [("a1", "p1", "rel"), ("a1", "v1", "rel")],
+    )
+    with pytest.raises(ValueError, match="mixed signatures"):
+        gexf_native.read_gexf_encoded(path)
+
+
+def test_native_encoded_zero_edges(tmp_path):
+    """A nodes-only GEXF must load (empty blocks dict), not crash on the
+    NULL data pointer of an empty COO vector."""
+    from distributed_pathsim_tpu.native import gexf_native
+
+    if not gexf_native.available():
+        pytest.skip("native parser unavailable")
+    p = tmp_path / "z.gexf"
+    p.write_text(
+        "<?xml version='1.0' encoding='utf-8'?>"
+        '<gexf version="1.2"><graph name="z"><nodes>'
+        '<node id="a1" label="A" /></nodes><edges /></graph></gexf>'
+    )
+    hin = gexf_native.read_gexf_encoded(str(p))
+    assert hin.blocks == {}
+    assert hin.type_size("") == 1 or len(hin.indices) == 1

@@ -162,3 +162,77 @@ def test_ktiled_topk_matches_single_pass_on_narrow(cd):
     v2, i2 = pk.fused_topk_ktiled(c, d, k=5, interpret=True)
     np.testing.assert_array_equal(np.asarray(v1), np.asarray(v2))
     np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+
+def test_twopass_topk_interpret(cd):
+    c, d, oracle = cd
+    vals, idxs = pk.fused_topk_twopass(c, d, k=5, interpret=True)
+    scores = oracle.all_pairs_scores()
+    np.fill_diagonal(scores, -np.inf)
+    for i in (0, 3, 100, 769):
+        expect = np.sort(scores[i])[::-1][:5]
+        np.testing.assert_allclose(
+            np.asarray(vals[i], dtype=np.float64), expect, atol=1e-7
+        )
+        np.testing.assert_allclose(
+            scores[i][np.asarray(idxs[i])], expect, atol=1e-7
+        )
+
+
+def test_twopass_topk_wide_contraction(wide_cd):
+    """APA: V = 1001 forces the K-tiled accumulator path inside the
+    two-pass kernel."""
+    c, d, oracle = wide_cd
+    vals, idxs = pk.fused_topk_twopass(c, d, k=5, interpret=True)
+    scores = oracle.all_pairs_scores()
+    np.fill_diagonal(scores, -np.inf)
+    for i in (0, 100, 769):
+        expect = np.sort(scores[i])[::-1][:5]
+        np.testing.assert_allclose(
+            np.asarray(vals[i], dtype=np.float64), expect, atol=1e-7
+        )
+
+
+def test_twopass_matches_single_pass(cd):
+    """Values must agree exactly with the fold kernel; indices must
+    agree wherever values are distinct (both tie-break to the lowest
+    column on equal values)."""
+    c, d, _ = cd
+    v1, i1 = pk.fused_topk(c, d, k=5, interpret=True)
+    v2, i2 = pk.fused_topk_twopass(c, d, k=5, interpret=True)
+    np.testing.assert_array_equal(np.asarray(v1), np.asarray(v2))
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+
+def test_twopass_no_self_mask(cd):
+    c, d, _ = cd
+    vals, idxs = pk.fused_topk_twopass(c, d, k=1, mask_self=False,
+                                       interpret=True)
+    assert idxs[0, 0] == 0
+    assert vals[0, 0] == pytest.approx(1 / 3, abs=1e-7)
+
+
+def test_twopass_rejects_large_k(cd):
+    c, d, _ = cd
+    with pytest.raises(ValueError):
+        pk.fused_topk_twopass(c, d, k=17, interpret=True)
+
+
+def test_twopass_zero_degree_targets_score_zero():
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(1)
+    n, v = 12, 4
+    c = (rng.random((n, v)) < 0.4).astype(np.float32)
+    c[5] = 0
+    d = (c @ c.sum(axis=0)).astype(np.float32)
+    k = n - 1
+    vals, idxs = pk.fused_topk_twopass(
+        jnp.asarray(c), jnp.asarray(d), k=k, interpret=True
+    )
+    for i in range(n):
+        if i == 5:
+            continue
+        row = dict(zip(np.asarray(idxs[i]).tolist(),
+                       np.asarray(vals[i]).tolist()))
+        assert row.get(5) == 0.0

@@ -149,3 +149,29 @@ def test_topk_tie_break_invariant_across_device_counts(dblp_small_hin, mp):
             np.asarray(vals), np.asarray(dense_v), atol=1e-6
         )
         np.testing.assert_array_equal(np.asarray(idxs), dense_i)
+
+
+def test_choose_allpairs_strategy():
+    from distributed_pathsim_tpu.parallel.sharded import (
+        _ALLGATHER_C_MAX_BYTES,
+        choose_allpairs_strategy,
+    )
+
+    # dblp/bench scale: gathered C is tiny -> allgather
+    assert choose_allpairs_strategy(32768, 384, 8) == "allgather"
+    # million-author regime: gathered C (1M x 4096 f32 = 16 GB) would
+    # crowd out HBM on every device -> ring
+    assert choose_allpairs_strategy(1_048_576, 4096, 8) == "ring"
+    # exact boundary honors the budget constant
+    n = _ALLGATHER_C_MAX_BYTES // (384 * 4)
+    assert choose_allpairs_strategy(n - 8, 384, 8) == "allgather"
+    assert choose_allpairs_strategy(n * 2, 384, 8) == "ring"
+
+
+def test_backend_auto_strategy_resolves(dblp_small_hin):
+    from distributed_pathsim_tpu.backends.base import create_backend
+    from distributed_pathsim_tpu.ops.metapath import compile_metapath
+
+    mp = compile_metapath("APVPA", dblp_small_hin.schema)
+    b = create_backend("jax-sharded", dblp_small_hin, mp, n_devices=4)
+    assert b.allpairs_strategy == "allgather"  # tiny gathered C
