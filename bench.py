@@ -184,7 +184,10 @@ def main() -> None:
     try:
         with open(out.name, encoding="utf-8") as f:
             raw = f.read()
-        if raw.startswith("# device:"):
+    except OSError:
+        raw = ""
+    if raw.startswith("# device:"):
+        try:  # best-effort: evidence loss must never eat the result
             os.makedirs(_RAW_DIR, exist_ok=True)
             with open(
                 os.path.join(_RAW_DIR, "tpu_bench_child_raw.txt"),
@@ -192,8 +195,8 @@ def main() -> None:
             ) as f:
                 f.write(f"# child rc={rc} (None = overstayed/abandoned)\n")
                 f.write(raw)
-    except OSError:
-        raw = ""
+        except OSError:
+            pass
     if rc == 0:
         lines = [l for l in raw.splitlines() if l.startswith("{")]
         if lines:

@@ -54,6 +54,14 @@ def build_parser() -> argparse.ArgumentParser:
         "CPU; 'auto' keeps JAX's own resolution",
     )
     p.add_argument(
+        "--loader",
+        default="auto",
+        choices=("auto", "python", "native"),
+        help="GEXF loader: 'native' requires the C++ parse+encode path, "
+        "'python' forces the pure-Python pipeline (escape hatch), "
+        "'auto' prefers native with clean fallback",
+    )
+    p.add_argument(
         "--tile-rows",
         type=int,
         default=None,
@@ -276,6 +284,7 @@ def _run(args) -> int:
         top_k=args.top_k,
         n_devices=args.n_devices,
         dtype=args.dtype,
+        loader=args.loader,
         tile_rows=args.tile_rows,
         approx=args.approx,
         echo=not args.quiet,
@@ -380,7 +389,11 @@ def _run_multipath(args) -> int:
             "(it always runs the batched jax rowsum-variant scorer)"
         )
 
-    hin = load_dataset(args.dataset)
+    from .engine import USE_NATIVE_BY_LOADER
+
+    hin = load_dataset(
+        args.dataset, use_native=USE_NATIVE_BY_LOADER[args.loader]
+    )
     if args.platform == "tpu":
         _require_tpu()  # load_dataset stays host-side; check before compute
     names = [s.strip() for s in args.metapath.split(",") if s.strip()]

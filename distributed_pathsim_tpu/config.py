@@ -26,6 +26,7 @@ class RunConfig:
     top_k: int = 0
     n_devices: int | None = None  # sharded backends: devices to use
     dtype: str = "float32"
+    loader: str = "auto"  # GEXF loader: auto | python | native
     tile_rows: int | None = None  # jax-sparse: rows per streaming tile
     approx: bool = False  # jax-sparse: waive the exact-count guard
     echo: bool = True

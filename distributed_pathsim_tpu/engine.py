@@ -16,6 +16,12 @@ from .driver import PathSimDriver
 from .ops.metapath import MetaPath, compile_metapath
 
 
+# --loader choice → read path: None prefers native with clean fallback,
+# False forces the exact Python pipeline, True requires native. One map,
+# shared by every caller that accepts the CLI-facing string.
+USE_NATIVE_BY_LOADER = {"auto": None, "python": False, "native": True}
+
+
 def load_dataset(path: str, use_native: bool | None = None) -> EncodedHIN:
     """GEXF → EncodedHIN. ``use_native`` mirrors read_gexf's tri-state:
     None prefers the C++ single-pass parse+encode with clean fallback,
@@ -31,10 +37,14 @@ def load_dataset(path: str, use_native: bool | None = None) -> EncodedHIN:
                 # dblp_large scale — see scripts/parser_bench.py artifact).
                 return gexf_native.read_gexf_encoded(path)
             if use_native is True:
-                raise RuntimeError("native GEXF loader requested but unavailable")
-        except OSError:  # toolchain/loader trouble: the Python path is exact
+                # ValueError: the CLI renders it as a clean one-liner.
+                raise ValueError(
+                    "native GEXF loader requested but unavailable "
+                    "(no C++ toolchain?)"
+                )
+        except OSError as exc:  # toolchain/loader trouble: Python is exact
             if use_native is True:
-                raise
+                raise ValueError(f"native GEXF loader failed: {exc}") from exc
     graph = read_gexf(path, use_native=False if use_native is False else None)
     return encode_hin(graph)
 
@@ -49,8 +59,15 @@ def build(
         from .utils.profiling import StageTimer
 
         timer = StageTimer()
+    if config.loader not in USE_NATIVE_BY_LOADER:
+        raise ValueError(
+            f"unknown loader {config.loader!r}; "
+            f"choose from {sorted(USE_NATIVE_BY_LOADER)}"
+        )
     with timer.stage("load_encode"):
-        hin = load_dataset(config.dataset)
+        hin = load_dataset(
+            config.dataset, use_native=USE_NATIVE_BY_LOADER[config.loader]
+        )
     with timer.stage("metapath_compile"):
         metapath = compile_metapath(config.metapath, hin.schema)
     options = {}

@@ -302,3 +302,24 @@ def test_multihost_env_rejects_non_sharded_backend(
     ])
     assert rc == 1
     assert "jax-sharded" in capsys.readouterr().err
+
+
+def test_loader_flag_python_and_native(dblp_small_path, tmp_path):
+    # Both loader pins must produce the identical golden log.
+    from distributed_pathsim_tpu.native import gexf_native
+
+    loaders = ["python"] + (["native"] if gexf_native.available() else [])
+    for loader in loaders:
+        out = tmp_path / f"l_{loader}.log"
+        rc = main([
+            "--dataset", dblp_small_path, "--backend", "numpy",
+            "--loader", loader,
+            "--source", "Didier Dubois", "--output", str(out), "--quiet",
+        ])
+        assert rc == 0
+        assert "Source author global walk: 3" in out.read_text()
+    if len(loaders) == 2:
+        a = (tmp_path / "l_python.log").read_text()
+        b = (tmp_path / "l_native.log").read_text()
+        assert [l for l in a.splitlines() if not l.startswith("***")] == \
+               [l for l in b.splitlines() if not l.startswith("***")]
