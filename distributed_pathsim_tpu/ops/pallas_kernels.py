@@ -358,9 +358,11 @@ _CAND = 16  # candidates kept per tile; exact for k <= _CAND
 _BN_WIDE = 1024
 
 
-def _extract_tile_topk(s, j, bn: int, cand: int, vals_ref, cols_ref):
-    """Write the top-``cand`` of each row of masked score tile ``s``
-    into the [bm, cand] output refs (values desc; global column ids).
+def _extract_tile_topk(s, j, bn: int, k: int, cand: int, vals_ref, cols_ref):
+    """Write the top-``k`` of each row of masked score tile ``s`` into
+    the [bm, cand] output refs (values desc, -inf beyond k; global
+    column ids). Only k rounds run — a tile can contribute at most k of
+    the global top-k, so lanes k..cand-1 stay -inf by construction.
     Tie-break: smallest column — matches ``lax.top_k``."""
     bm = s.shape[0]
     lcols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
@@ -368,7 +370,7 @@ def _extract_tile_topk(s, j, bn: int, cand: int, vals_ref, cols_ref):
     big = jnp.int32(2**30)
     new_v = jnp.full((bm, cand), -jnp.inf, dtype=s.dtype)
     new_c = jnp.zeros((bm, cand), dtype=jnp.int32)
-    for t in range(cand):
+    for t in range(k):
         vmax = jnp.max(s, axis=1, keepdims=True)
         pos = jnp.min(jnp.where(s == vmax, lcols, big), axis=1, keepdims=True)
         new_v = jnp.where(out_col == t, vmax, new_v)
@@ -378,18 +380,18 @@ def _extract_tile_topk(s, j, bn: int, cand: int, vals_ref, cols_ref):
     cols_ref[:] = new_c
 
 
-def _topk2_kernel(cand: int, bn: int, mask_self: bool, n_true: int,
+def _topk2_kernel(k: int, cand: int, bn: int, mask_self: bool, n_true: int,
                   c_i_ref, c_j_ref, d_i_ref, d_j_ref, vals_ref, cols_ref):
     i = pl.program_id(0)
     j = pl.program_id(1)
     s = _normalize(_tile_dot(c_i_ref, c_j_ref), d_i_ref, d_j_ref)
     s, _ = _mask_tile(s, i, j, n_true, mask_self)
-    _extract_tile_topk(s, j, bn, cand, vals_ref, cols_ref)
+    _extract_tile_topk(s, j, bn, k, cand, vals_ref, cols_ref)
 
 
-def _topk2_kernel_kt(cand: int, bn: int, mask_self: bool, n_true: int,
-                     n_kb: int, c_i_ref, c_j_ref, d_i_ref, d_j_ref,
-                     vals_ref, cols_ref, acc_ref):
+def _topk2_kernel_kt(k: int, cand: int, bn: int, mask_self: bool,
+                     n_true: int, n_kb: int, c_i_ref, c_j_ref, d_i_ref,
+                     d_j_ref, vals_ref, cols_ref, acc_ref):
     i = pl.program_id(0)
     j = pl.program_id(1)
     kb = pl.program_id(2)
@@ -404,7 +406,7 @@ def _topk2_kernel_kt(cand: int, bn: int, mask_self: bool, n_true: int,
     def _finish():
         s = _normalize(acc_ref[:], d_i_ref, d_j_ref)
         s, _ = _mask_tile(s, i, j, n_true, mask_self)
-        _extract_tile_topk(s, j, bn, cand, vals_ref, cols_ref)
+        _extract_tile_topk(s, j, bn, k, cand, vals_ref, cols_ref)
 
 
 @functools.partial(
@@ -445,7 +447,7 @@ def fused_topk_twopass(
     )
     if n_kb == 1:
         vals, cols = pl.pallas_call(
-            functools.partial(_topk2_kernel, _CAND, bn, mask_self, n),
+            functools.partial(_topk2_kernel, k, _CAND, bn, mask_self, n),
             grid=grid_ij,
             in_specs=[
                 pl.BlockSpec((_BM, v_pad), lambda i, j: (i, 0)),
@@ -462,7 +464,7 @@ def fused_topk_twopass(
     else:
         vals, cols = pl.pallas_call(
             functools.partial(
-                _topk2_kernel_kt, _CAND, bn, mask_self, n, n_kb
+                _topk2_kernel_kt, k, _CAND, bn, mask_self, n, n_kb
             ),
             grid=grid_ij + (n_kb,),
             in_specs=[

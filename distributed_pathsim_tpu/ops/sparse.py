@@ -205,6 +205,41 @@ def chunked_row_topk(s, cols, k: int, chunk: int = 512):
 
 
 @functools.partial(jax.jit, static_argnames=("k", "n_true"))
+def stream_merge_topk_pair(ci, cj, di, dj, bi_v, bi_i, bj_v, bj_i,
+                           i0, j0, k: int, n_true: int):
+    """Score ONE [Ti, Tj] tile and fold it into BOTH running top-ks:
+    tile i's rows directly and tile j's rows via the transpose — the
+    score matrix is symmetric (M = C·Cᵀ, denom symmetric), so one GEMM
+    serves two row blocks. This is the off-diagonal workhorse of the
+    symmetric streaming pass: half the GEMMs of the naive full sweep.
+    """
+    with jax.default_matmul_precision("highest"):
+        m = jnp.matmul(ci, cj.T)
+    denom = di[:, None] + dj[None, :]
+    s = jnp.where(denom > 0, 2.0 * m / jnp.where(denom > 0, denom, 1.0), 0.0)
+    rows = i0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    cols = j0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    # Mask padding on BOTH axes (each is the column axis of one of the
+    # two folds) and self-pairs (symmetric by construction).
+    s = jnp.where(cols >= n_true, -jnp.inf, s)
+    s = jnp.where(rows >= n_true, -jnp.inf, s)
+    s = jnp.where(rows == cols, -jnp.inf, s)
+    tile_v, tile_i = chunked_row_topk(s, cols, k)
+    merged_v = jnp.concatenate([bi_v, tile_v], axis=1)
+    merged_i = jnp.concatenate([bi_i, tile_i], axis=1)
+    v, p = jax.lax.top_k(merged_v, k)
+    bi_v, bi_i = v, jnp.take_along_axis(merged_i, p, axis=1)
+
+    st = s.T  # [Tj, Ti]; columns of the transposed view are tile i rows
+    tile_vt, tile_it = chunked_row_topk(st, rows.T, k)
+    merged_v = jnp.concatenate([bj_v, tile_vt], axis=1)
+    merged_i = jnp.concatenate([bj_i, tile_it], axis=1)
+    v, p = jax.lax.top_k(merged_v, k)
+    bj_v, bj_i = v, jnp.take_along_axis(merged_i, p, axis=1)
+    return bi_v, bi_i, bj_v, bj_i
+
+
+@functools.partial(jax.jit, static_argnames=("k", "n_true"))
 def stream_merge_topk(ci, cj, di, dj, best_v, best_i, i0, j0,
                       k: int, n_true: int):
     """Fold one [Ti, Tj] score tile into the running per-row top-k,

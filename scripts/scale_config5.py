@@ -60,6 +60,13 @@ def parse_args(argv=None):
         "float32 with --approx",
     )
     p.add_argument(
+        "--symmetric", action="store_true",
+        help="use the symmetric half-sweep (each (i,j>=i) tile folds "
+        "into both row blocks). Measured SLOWER at V=64 on CPU (the "
+        "pass is selection-bound) — off by default; kept for A/B "
+        "timing and wide-V regimes; same results either way",
+    )
+    p.add_argument(
         "--approx", action="store_true",
         help="waive the f32 exact-count guard: Zipf-headed graphs at "
         "this scale have path counts far beyond 2^24 by construction; "
@@ -116,7 +123,8 @@ def main(argv=None) -> dict:
 
     t0 = time.perf_counter()
     vals, idxs = backend.topk_scores(
-        k=args.top_k, checkpoint_dir=args.checkpoint_dir
+        k=args.top_k, checkpoint_dir=args.checkpoint_dir,
+        symmetric=args.symmetric,
     )
     t_rank = time.perf_counter() - t0
 
@@ -162,6 +170,7 @@ def main(argv=None) -> dict:
             "platform": args.platform,
             "dtype": args.dtype,
             "exact_counts": not args.approx,
+            "symmetric_half_sweep": args.symmetric,
         },
         "seconds": {
             "synthetic_build": round(t_build, 3),

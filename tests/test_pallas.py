@@ -236,3 +236,28 @@ def test_twopass_zero_degree_targets_score_zero():
         row = dict(zip(np.asarray(idxs[i]).tolist(),
                        np.asarray(vals[i]).tolist()))
         assert row.get(5) == 0.0
+
+
+def test_twopass_odd_shapes_and_k_boundary():
+    """Non-tile-multiple N, k at the _CAND boundary, and k > n-1."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(5)
+    n, v = 301, 17
+    c = (rng.random((n, v)) < 0.2).astype(np.float32)
+    d = (c @ c.sum(axis=0)).astype(np.float32)
+    m = c.astype(np.float64) @ c.astype(np.float64).T
+    dd = m.sum(axis=1)
+    denom = dd[:, None] + dd[None, :]
+    scores = np.where(denom > 0, 2 * m / np.where(denom > 0, denom, 1), 0.0)
+    np.fill_diagonal(scores, -np.inf)
+    for k in (1, 10, 16):
+        vals, idxs = pk.fused_topk_twopass(
+            jnp.asarray(c), jnp.asarray(d), k=k, interpret=True
+        )
+        assert vals.shape == (n, k)
+        for i in (0, 150, 300):
+            expect = np.sort(scores[i])[::-1][:k]
+            np.testing.assert_allclose(
+                np.asarray(vals[i], dtype=np.float64), expect, atol=1e-7
+            )

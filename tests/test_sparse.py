@@ -153,3 +153,110 @@ def test_chunked_row_topk_matches_flat_topk():
         ev, ep = jax.lax.top_k(jnp.asarray(s), min(7, w))
         np.testing.assert_array_equal(np.asarray(v), np.asarray(ev))
         np.testing.assert_array_equal(np.asarray(c), np.asarray(ep))
+
+
+def _sparse_backend(dblp_small_hin, tile_rows=256):
+    from distributed_pathsim_tpu.backends.base import create_backend
+    from distributed_pathsim_tpu.ops.metapath import compile_metapath
+
+    mp = compile_metapath("APVPA", dblp_small_hin.schema)
+    return create_backend(
+        "jax-sparse", dblp_small_hin, mp, tile_rows=tile_rows
+    )
+
+
+def test_symmetric_sweep_equals_full_sweep(dblp_small_hin):
+    """The symmetric half-sweep must reproduce the full sweep EXACTLY —
+    values and indices (tie-breaks included), multi-tile shapes."""
+    b = _sparse_backend(dblp_small_hin)
+    v_full, i_full = b.topk_scores(k=5, symmetric=False)
+    v_sym, i_sym = b.topk_scores(k=5, symmetric=True)
+    np.testing.assert_array_equal(v_full, v_sym)
+    np.testing.assert_array_equal(i_full, i_sym)
+
+
+def test_symmetric_sweep_resumes_after_crash(dblp_small_hin, tmp_path, monkeypatch):
+    """Kill the symmetric pass mid-sweep; the rerun must resume from the
+    newest partials snapshot and produce identical results."""
+    from distributed_pathsim_tpu.backends.jax_sparse import JaxSparseBackend
+    from distributed_pathsim_tpu.ops import sparse as sp
+
+    monkeypatch.setattr(JaxSparseBackend, "_PARTIALS_EVERY", 1)
+    b = _sparse_backend(dblp_small_hin)
+    want_v, want_i = b.topk_scores(k=4, symmetric=True)
+
+    ck = str(tmp_path / "ck")
+    b2 = _sparse_backend(dblp_small_hin)
+    calls = {"n": 0}
+    real = sp.stream_merge_topk_pair
+
+    def dying(*a, **kw):
+        calls["n"] += 1
+        if calls["n"] > 3:
+            raise RuntimeError("simulated crash")
+        return real(*a, **kw)
+
+    sp.stream_merge_topk_pair = dying
+    try:
+        with pytest.raises(RuntimeError, match="simulated crash"):
+            b2.topk_scores(k=4, checkpoint_dir=ck, symmetric=True)
+    finally:
+        sp.stream_merge_topk_pair = real
+
+    # at least one outer tile must have completed for a real resume test
+    from distributed_pathsim_tpu.utils.checkpoint import CheckpointManager
+
+    done = CheckpointManager(ck).done_keys()
+    snaps = [d for d in done if d.startswith("sym_partials_after_")]
+    assert snaps, done
+
+    b3 = _sparse_backend(dblp_small_hin)
+    got_v, got_i = b3.topk_scores(k=4, checkpoint_dir=ck, symmetric=True)
+    np.testing.assert_array_equal(want_v, got_v)
+    np.testing.assert_array_equal(want_i, got_i)
+    # exactly one snapshot survives a completed run (older ones dropped)
+    done_after = CheckpointManager(ck).done_keys()
+    assert len(
+        [d for d in done_after if d.startswith("sym_partials_after_")]
+    ) == 1
+
+
+def test_symmetric_sweep_resumes_without_snapshot(dblp_small_hin, tmp_path):
+    """A crash before the first partials snapshot restarts from scratch
+    and still produces correct results (row units are overwritten)."""
+    from distributed_pathsim_tpu.ops import sparse as sp
+
+    b = _sparse_backend(dblp_small_hin)
+    want_v, want_i = b.topk_scores(k=3, symmetric=True)
+
+    ck = str(tmp_path / "ck")
+    b2 = _sparse_backend(dblp_small_hin)
+    real = sp.stream_merge_topk_pair
+    calls = {"n": 0}
+
+    def dying(*a, **kw):
+        calls["n"] += 1
+        if calls["n"] > 4:  # past outer tile 0 (3 pairs), mid tile 1
+            raise RuntimeError("boom")
+        return real(*a, **kw)
+
+    sp.stream_merge_topk_pair = dying
+    try:
+        with pytest.raises(RuntimeError, match="boom"):
+            b2.topk_scores(k=3, checkpoint_dir=ck, symmetric=True)
+    finally:
+        sp.stream_merge_topk_pair = real
+    # default cadence (8) means no snapshot exists yet at 4 tiles
+    got_v, got_i = _sparse_backend(dblp_small_hin).topk_scores(
+        k=3, checkpoint_dir=ck, symmetric=True
+    )
+    np.testing.assert_array_equal(want_v, got_v)
+    np.testing.assert_array_equal(want_i, got_i)
+
+
+def test_symmetric_and_full_checkpoints_do_not_mix(dblp_small_hin, tmp_path):
+    ck = str(tmp_path / "ck")
+    b = _sparse_backend(dblp_small_hin)
+    b.topk_scores(k=3, checkpoint_dir=ck, symmetric=True)
+    with pytest.raises(ValueError, match="format"):
+        b.topk_scores(k=3, checkpoint_dir=ck, symmetric=False)
