@@ -72,9 +72,19 @@ class JaxDenseBackend(PathSimBackend):
     """Dense chain on one device (TPU when available, else host backend)."""
 
     def __init__(self, hin, metapath, dtype=jnp.float32, device=None,
-                 use_pallas: bool | None = None, **options):
+                 use_pallas: bool | None = None, exact_counts: bool = True,
+                 **options):
+        """``exact_counts=False`` mirrors the sparse backend's approx
+        mode: waives the f32 2^24 exact-integer guard for graphs whose
+        path counts overflow it by construction (scores are
+        scale-invariant ratios in C, so the cost is ~1e-6 relative
+        rounding, inside the 1e-5 gate — jax_sparse.py has the full
+        argument). Needed when the dense-resident path runs the
+        million-author regime on a TPU (C [1M, V] is only ~256 MB at
+        V=64; the guard, not memory, is what would refuse it)."""
         super().__init__(hin, metapath, **options)
         self.dtype = dtype
+        self.exact_counts = exact_counts
         self.use_pallas = pk.pallas_supported() if use_pallas is None else use_pallas
         self._symmetric = metapath.is_symmetric
         if self._symmetric:
@@ -121,7 +131,8 @@ class JaxDenseBackend(PathSimBackend):
         return self._m, self._rowsums
 
     def _check_exact(self, rowsums: np.ndarray) -> None:
-        chain.check_exact_counts(rowsums.max(initial=0.0), self.dtype)
+        if self.exact_counts:
+            chain.check_exact_counts(rowsums.max(initial=0.0), self.dtype)
 
     def commuting_matrix(self) -> np.ndarray:
         return self._compute()[0]

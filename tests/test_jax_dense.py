@@ -39,3 +39,32 @@ def test_single_source_scores(pair, dblp_small_hin):
     np.testing.assert_allclose(
         jx.scores_from_source(i), oracle.scores_from_source(i), rtol=1e-6
     )
+
+
+def test_dense_exact_counts_waiver(dblp_small_hin, monkeypatch):
+    """exact_counts=False must skip the overflow guard (approx mode for
+    the million-author dense-resident path); exact_counts=True must hit
+    it. dblp_small's counts never overflow, so the guard is forced to
+    fire via monkeypatch — identical-result comparison alone could not
+    detect the flag being ignored."""
+    from distributed_pathsim_tpu.backends.base import create_backend
+    from distributed_pathsim_tpu.ops import chain
+    from distributed_pathsim_tpu.ops.metapath import compile_metapath
+
+    mp = compile_metapath("APVPA", dblp_small_hin.schema)
+    a = create_backend("jax", dblp_small_hin, mp)
+    b = create_backend("jax", dblp_small_hin, mp, exact_counts=False)
+    np.testing.assert_array_equal(a.global_walks(), b.global_walks())
+    va, ia = a.topk(k=3)
+    vb, ib = b.topk(k=3)
+    np.testing.assert_array_equal(va, vb)
+    np.testing.assert_array_equal(ia, ib)
+
+    def always_overflow(*_a, **_k):
+        raise OverflowError("forced")
+
+    monkeypatch.setattr(chain, "check_exact_counts", always_overflow)
+    with pytest.raises(OverflowError):
+        create_backend("jax", dblp_small_hin, mp).global_walks()
+    waived = create_backend("jax", dblp_small_hin, mp, exact_counts=False)
+    waived.global_walks()  # guard skipped: no raise
