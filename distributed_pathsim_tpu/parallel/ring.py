@@ -19,6 +19,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ..ops.sparse import chunked_row_topk
+
 
 def ring_allpairs_rowblock(c_local: jax.Array, axis: str) -> jax.Array:
     """Inside shard_map: compute this device's row-block of M = C Cᵀ by
@@ -114,8 +116,14 @@ def ring_topk_rowblock(
         s = jnp.where(cols >= n_true, -jnp.inf, s)  # padding columns
         if mask_self:
             s = jnp.where(rows == cols, -jnp.inf, s)
-        merged_v = jnp.concatenate([best_v, s], axis=1)
-        merged_i = jnp.concatenate([best_i, cols], axis=1)
+        # Hierarchical prefilter narrows this step's tile to k candidates
+        # (ascending-column tie-breaks, same as the final sort) BEFORE
+        # the lexicographic merge — sorting the raw [n_loc, n_loc+k]
+        # concat each step costs O(n_loc log n_loc) per row and was the
+        # fold's dominant term at n_loc ≥ 4k (measured 4.3×).
+        tile_v, tile_i = chunked_row_topk(s, cols, k)
+        merged_v = jnp.concatenate([best_v, tile_v], axis=1)
+        merged_i = jnp.concatenate([best_i, tile_i], axis=1)
         best_v, best_i = _merge_topk_by_col(merged_v, merged_i, k)
         block = jax.lax.ppermute(block, axis, perm)
         d_block = jax.lax.ppermute(d_block, axis, perm)
