@@ -374,7 +374,6 @@ def _run_multipath(args) -> int:
         "--variant": args.variant != "rowsum",
         "--backend": args.backend != "jax",
         "--dtype": args.dtype != "float32",
-        "--n-devices": args.n_devices is not None,
         "--output": args.output is not None,
         "--metrics": args.metrics is not None,
         "--ranking-out": args.ranking_out is not None,
@@ -387,6 +386,16 @@ def _run_multipath(args) -> int:
         raise ValueError(
             f"multi-metapath mode does not support {', '.join(bad)} "
             "(it always runs the batched jax rowsum-variant scorer)"
+        )
+    if args.n_devices is not None and not (
+        args.top_k and not (args.source or args.source_id)
+    ):
+        # The flag must never be silently ignored: in this mode only the
+        # all-sources ranking is sharded (--all-pairs and single-source
+        # scoring run on the host).
+        raise ValueError(
+            "--n-devices in multi-metapath mode applies to the "
+            "all-sources ranking (--top-k without --source)"
         )
 
     from .engine import USE_NATIVE_BY_LOADER
@@ -424,6 +433,23 @@ def _run_multipath(args) -> int:
         for v, j in zip(vals, idxs):
             print(f"  {v:.6f}  {labels[j]} ({hin.indices[node_type].ids[j]})")
         ran = True
+    if args.top_k and not (args.source or args.source_id):
+        # All-sources ensemble ranking — sharded over a dp mesh when
+        # --n-devices is given (models/multipath.topk_sharded), host
+        # argpartition otherwise.
+        if args.n_devices is not None:
+            vals, idxs = scorer.topk_sharded(
+                k=args.top_k, weights=weights, n_devices=args.n_devices
+            )
+            how = f"sharded over {args.n_devices} devices"
+        else:
+            vals, idxs = scorer.topk(k=args.top_k, weights=weights)
+            how = "host"
+        print(
+            f"Ranked top-{vals.shape[1]} for all {vals.shape[0]} sources "
+            f"(combined {scorer.names}, {how})"
+        )
+        ran = True
     if args.all_pairs:
         comb = scorer.combined_scores(weights)
         print(
@@ -432,8 +458,8 @@ def _run_multipath(args) -> int:
         )
         ran = True
     if not ran:
-        print("Nothing to do: pass --source/--source-id and/or --all-pairs",
-              file=sys.stderr)
+        print("Nothing to do: pass --source/--source-id, --top-k, "
+              "and/or --all-pairs", file=sys.stderr)
         return 2
     return 0
 

@@ -44,6 +44,48 @@ def _combine(scores: jax.Array, weights: jax.Array):
     return jnp.einsum("rnm,r->nm", scores, weights)
 
 
+@functools.partial(jax.jit, static_argnames=("mesh", "k", "n_true"))
+def _sharded_combined_topk(c_stack, weights, mesh, k: int, n_true: int):
+    """Distributed weighted multi-path top-k: the author axis of the
+    stacked half-chain factors [R, N_pad, V] is row-sharded over ``dp``;
+    each device scores its row block of ALL R paths in one batched
+    einsum against the gathered factor, combines with the ensemble
+    weights in VMEM-resident form, and reduces to top-k locally. The
+    only collectives are one ``psum`` (per-path column totals) and the
+    ``all_gather`` of the C stack — the [R, N, N] score tensors never
+    exist anywhere.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from ..ops.sparse import chunked_row_topk
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(None, "dp", None), P()),
+        out_specs=(P("dp", None), P("dp", None)),
+    )
+    def run(c_loc, w):  # c_loc: [R, n_loc, V]
+        n_loc = c_loc.shape[1]
+        my = jax.lax.axis_index("dp")
+        with jax.default_matmul_precision("highest"):
+            colsums = jax.lax.psum(jnp.sum(c_loc, axis=1), "dp")  # [R, V]
+            d_loc = jnp.einsum("rnv,rv->rn", c_loc, colsums)
+            c_full = jax.lax.all_gather(c_loc, "dp", axis=1, tiled=True)
+            d_full = jax.lax.all_gather(d_loc, "dp", axis=1, tiled=True)
+            m = jnp.einsum("rnv,rmv->rnm", c_loc, c_full)  # [R, n_loc, N]
+        denom = d_loc[:, :, None] + d_full[:, None, :]
+        s = jnp.where(denom > 0, 2.0 * m / jnp.where(denom > 0, denom, 1.0), 0.0)
+        comb = jnp.einsum("rnm,r->nm", s, w)
+        rows = my * n_loc + jax.lax.broadcasted_iota(jnp.int32, comb.shape, 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, comb.shape, 1)
+        comb = jnp.where(cols >= n_true, -jnp.inf, comb)
+        comb = jnp.where(rows == cols, -jnp.inf, comb)
+        return chunked_row_topk(comb, cols, k)
+
+    return run(c_stack, weights)
+
+
 class MultiMetapathScorer:
     """Batched PathSim over several symmetric metapaths on one HIN."""
 
@@ -107,10 +149,9 @@ class MultiMetapathScorer:
         """[R, N] per-path row sums (the reference's global walks)."""
         return self._compute()[1]
 
-    def combined_scores(self, weights: Sequence[float] | None = None) -> np.ndarray:
-        """Weighted multi-path similarity: Σ_r w_r · sim_r, [N, N].
-        Default weights are uniform (mean over paths)."""
-        self._compute()
+    def _resolve_weights(self, weights: Sequence[float] | None) -> np.ndarray:
+        """Uniform default / float32 cast / shape check — one place, so
+        the host and sharded paths can never diverge on weight handling."""
         r = len(self.metapaths)
         w = (
             np.full(r, 1.0 / r, dtype=np.float32)
@@ -119,6 +160,13 @@ class MultiMetapathScorer:
         )
         if w.shape != (r,):
             raise ValueError(f"need {r} weights, got shape {w.shape}")
+        return w
+
+    def combined_scores(self, weights: Sequence[float] | None = None) -> np.ndarray:
+        """Weighted multi-path similarity: Σ_r w_r · sim_r, [N, N].
+        Default weights are uniform (mean over paths)."""
+        self._compute()
+        w = self._resolve_weights(weights)
         return np.asarray(_combine(jnp.asarray(self._scores), jnp.asarray(w)))
 
     def topk(self, k: int = 10, weights: Sequence[float] | None = None):
@@ -133,6 +181,35 @@ class MultiMetapathScorer:
         idxs = np.take_along_axis(part, order, axis=1)
         vals = np.take_along_axis(part_vals, order, axis=1)
         return vals, idxs
+
+    def topk_sharded(
+        self,
+        k: int = 10,
+        weights: Sequence[float] | None = None,
+        n_devices: int | None = None,
+    ):
+        """Distributed :meth:`topk` over a ``dp`` device mesh (config-4
+        batching × config-3 sharding): identical values and the
+        ascending-column tie-breaks of ``lax.top_k`` (NB: :meth:`topk`'s
+        host argpartition is value-identical but breaks ties
+        arbitrarily). Scales the batched ensemble past one device's
+        memory — the [R, N, N] score tensors never materialize.
+        """
+        from ..parallel.mesh import make_mesh, pad_to_multiple
+
+        mesh = make_mesh(n_devices)
+        w = self._resolve_weights(weights)
+        n_pad = pad_to_multiple(self.n, mesh.shape["dp"])
+        stack = self._c_stack
+        if n_pad != self.n:
+            stack = jnp.pad(stack, ((0, 0), (0, n_pad - self.n), (0, 0)))
+        vals, idxs = _sharded_combined_topk(
+            stack, jnp.asarray(w), mesh, k=min(k, self.n - 1), n_true=self.n
+        )
+        return (
+            np.asarray(vals, dtype=np.float64)[: self.n],
+            np.asarray(idxs, dtype=np.int64)[: self.n],
+        )
 
     def topk_row(self, row: int, k: int = 10, weights: Sequence[float] | None = None):
         """Top-k for ONE source row — ranks only that row."""

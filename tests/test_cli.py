@@ -323,3 +323,30 @@ def test_loader_flag_python_and_native(dblp_small_path, tmp_path):
         b = (tmp_path / "l_native.log").read_text()
         assert [l for l in a.splitlines() if not l.startswith("***")] == \
                [l for l in b.splitlines() if not l.startswith("***")]
+
+
+def test_multipath_rank_all_host_and_sharded(dblp_small_path, capsys):
+    rc = main([
+        "--dataset", dblp_small_path,
+        "--metapath", "APVPA,APA", "--top-k", "3", "--quiet",
+    ])
+    assert rc == 0
+    assert "Ranked top-3 for all 770 sources" in capsys.readouterr().out
+    rc = main([
+        "--dataset", dblp_small_path,
+        "--metapath", "APVPA,APA", "--top-k", "3",
+        "--n-devices", "4", "--quiet",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "sharded over 4 devices" in out
+
+
+def test_multipath_n_devices_requires_rank_all(dblp_small_path, capsys):
+    rc = main([
+        "--dataset", dblp_small_path,
+        "--metapath", "APVPA,APA",
+        "--source", "Didier Dubois", "--n-devices", "4", "--quiet",
+    ])
+    assert rc == 1
+    assert "all-sources ranking" in capsys.readouterr().err

@@ -82,3 +82,34 @@ def test_topk_row_matches_topk(topic_hin):
     for i in (0, 123, 299):
         rv, ri = scorer.topk_row(i, k=5)
         np.testing.assert_allclose(rv, vals[i])
+
+
+def test_topk_sharded_matches_host_topk(dblp_small_hin):
+    """The distributed ensemble top-k must reproduce the host path's
+    values exactly; indices must point at rows achieving those values
+    (host argpartition breaks ties arbitrarily, the sharded path by
+    ascending column)."""
+    from distributed_pathsim_tpu.models.multipath import MultiMetapathScorer
+
+    scorer = MultiMetapathScorer(dblp_small_hin, ["APVPA", "APA"])
+    want_v, _ = scorer.topk(k=5, weights=[0.7, 0.3])
+    got_v, got_i = scorer.topk_sharded(k=5, weights=[0.7, 0.3], n_devices=8)
+    np.testing.assert_allclose(got_v, want_v, atol=1e-6)
+    comb = scorer.combined_scores([0.7, 0.3]).copy()
+    np.fill_diagonal(comb, -np.inf)
+    for row in (0, 123, 769):
+        np.testing.assert_allclose(
+            comb[row][got_i[row]], got_v[row], atol=1e-6
+        )
+
+
+def test_topk_sharded_uneven_rows(dblp_small_hin):
+    # 770 rows over 4 devices: padding rows must be invisible
+    from distributed_pathsim_tpu.models.multipath import MultiMetapathScorer
+
+    scorer = MultiMetapathScorer(dblp_small_hin, ["APVPA"])
+    got_v, got_i = scorer.topk_sharded(k=3, n_devices=4)
+    want_v, _ = scorer.topk(k=3)
+    np.testing.assert_allclose(got_v, want_v, atol=1e-6)
+    assert got_v.shape == (770, 3)
+    assert int(got_i.max()) < 770
