@@ -177,9 +177,10 @@ class JaxDenseBackend(PathSimBackend):
         if not self._symmetric:
             raise ValueError("topk fast path requires a symmetric metapath")
         c, rowsums = self._half()
-        if self.use_pallas and k <= pk._CAND:
+        if self.use_pallas and k <= pk._CAND and pk.twopass_fits(c.shape[0]):
             # Fastest path: candidate extraction + XLA reduce (handles
-            # any V internally); measured ~3x the single-pass fold.
+            # any V internally). Beyond the candidate-buffer HBM budget
+            # (~256k rows) the fold kernel below takes over.
             vals, idxs = pk.fused_topk_twopass(
                 c, rowsums, k=k, mask_self=mask_self
             )

@@ -356,6 +356,19 @@ def fused_topk_ktiled(
 
 _CAND = 16  # candidates kept per tile; exact for k <= _CAND
 _BN_WIDE = 1024
+# The candidate buffer is [N_pad, (N_pad/_BN_WIDE)·_CAND] f32+i32 —
+# ~0.5% of the score matrix. Fine through ~256k authors (≈8 GB HBM at
+# 262k); beyond that the single-pass fold kernel (O(N·k_pad) state)
+# takes over.
+_TWOPASS_CAND_MAX_BYTES = 8 << 30
+
+
+def twopass_fits(n: int) -> bool:
+    """True when fused_topk_twopass's candidate buffer fits the HBM
+    budget at this row count; callers fall back to fused_topk beyond."""
+    n_pad = _ceil_to(max(n, 8), max(_BM, _BN_WIDE))
+    cand_bytes = n_pad * (n_pad // _BN_WIDE) * _CAND * 8
+    return cand_bytes <= _TWOPASS_CAND_MAX_BYTES
 
 
 def _extract_tile_topk(s, j, bn: int, k: int, cand: int, vals_ref, cols_ref):

@@ -261,3 +261,9 @@ def test_twopass_odd_shapes_and_k_boundary():
             np.testing.assert_allclose(
                 np.asarray(vals[i], dtype=np.float64), expect, atol=1e-7
             )
+
+
+def test_twopass_fits_budget():
+    assert pk.twopass_fits(32768)
+    assert pk.twopass_fits(262144)
+    assert not pk.twopass_fits(1_048_576)
