@@ -343,8 +343,17 @@ def fused_topk_ktiled(
 #   pass 1 (pallas): per [bm × bn] tile, extract the tile-local top-C
 #     candidates (C = 16 ≥ k) straight out of the score tile — k rounds
 #     of max-extract over ONE tile, no concatenated running buffer —
-#     and write the [bm, C] winners to a small HBM candidate buffer
-#     (N × n_tiles × C ≈ 0.5% of the score matrix at N=32k, bn=1024).
+#     and write the [bm, C] winners to an HBM candidate buffer (~25%
+#     of the score matrix's bytes once HBM lane padding is counted —
+#     see the note at _TWOPASS_CAND_MAX_BYTES — vs 100% + a second
+#     full read for an unfused scores+top_k).
+#     Layout: Mosaic requires an output block's lane dim to be a
+#     multiple of 128 OR equal to the array's lane dim, so the [bm, C]
+#     blocks land in distinct ROW blocks of a [n_j·N_pad, C] buffer
+#     (row j·N_pad + i·bm; lane dim C == array lane dim at every
+#     shape) rather than C-wide column slices — the latter lowers only
+#     when n_j == 1, which is exactly the trap interpret-mode tests
+#     can't see.
 #   pass 2 (XLA): exact hierarchical top-k over the candidates
 #     (ops/sparse.chunked_row_topk) — any global top-k element is its
 #     tile's top-k, so this is exact for k ≤ C.
@@ -356,18 +365,22 @@ def fused_topk_ktiled(
 
 _CAND = 16  # candidates kept per tile; exact for k <= _CAND
 _BN_WIDE = 1024
-# The candidate buffer is [N_pad, (N_pad/_BN_WIDE)·_CAND] f32+i32 —
-# ~0.5% of the score matrix. Fine through ~256k authors (≈8 GB HBM at
-# 262k); beyond that the single-pass fold kernel (O(N·k_pad) state)
-# takes over.
+# The candidate buffer is [(N_pad/_BN_WIDE)·N_pad, _CAND] f32+i32. TPU
+# HBM layouts are (8, 128)-tiled, so the 16-wide minor dim is padded to
+# 128 lanes: the PHYSICAL footprint is n_j·N_pad·128·8 B ≈ N_pad²
+# bytes — ~25% of the (never-materialized) f32 score matrix, ~1 GB at
+# the 32k bench shape. The budget admits up to ~92k authors; beyond
+# that the single-pass fold kernel (O(N·k_pad) state) takes over.
 _TWOPASS_CAND_MAX_BYTES = 8 << 30
+_HBM_LANE = 128  # minor-dim padding granularity of TPU HBM tiles
 
 
 def twopass_fits(n: int) -> bool:
     """True when fused_topk_twopass's candidate buffer fits the HBM
     budget at this row count; callers fall back to fused_topk beyond."""
     n_pad = _ceil_to(max(n, 8), max(_BM, _BN_WIDE))
-    cand_bytes = n_pad * (n_pad // _BN_WIDE) * _CAND * 8
+    lanes = max(_CAND, _HBM_LANE)
+    cand_bytes = (n_pad // _BN_WIDE) * n_pad * lanes * 8
     return cand_bytes <= _TWOPASS_CAND_MAX_BYTES
 
 
@@ -450,11 +463,12 @@ def fused_topk_twopass(
     d_p = jnp.zeros((n_pad, 1), dtype=jnp.float32).at[:n, 0].set(rowsums)
 
     n_j = n_pad // bn
-    grid_ij = (n_pad // _BM, n_j)
+    n_bi = n_pad // _BM  # row blocks per column-tile stripe
+    grid_ij = (n_bi, n_j)
     common = dict(
         out_shape=(
-            jax.ShapeDtypeStruct((n_pad, n_j * _CAND), jnp.float32),
-            jax.ShapeDtypeStruct((n_pad, n_j * _CAND), jnp.int32),
+            jax.ShapeDtypeStruct((n_j * n_pad, _CAND), jnp.float32),
+            jax.ShapeDtypeStruct((n_j * n_pad, _CAND), jnp.int32),
         ),
         interpret=interpret,
     )
@@ -469,8 +483,8 @@ def fused_topk_twopass(
                 pl.BlockSpec((bn, 1), lambda i, j: (j, 0)),
             ],
             out_specs=(
-                pl.BlockSpec((_BM, _CAND), lambda i, j: (i, j)),
-                pl.BlockSpec((_BM, _CAND), lambda i, j: (i, j)),
+                pl.BlockSpec((_BM, _CAND), lambda i, j: (j * n_bi + i, 0)),
+                pl.BlockSpec((_BM, _CAND), lambda i, j: (j * n_bi + i, 0)),
             ),
             **common,
         )(c_p, c_p, d_p, d_p)
@@ -487,17 +501,32 @@ def fused_topk_twopass(
                 pl.BlockSpec((bn, 1), lambda i, j, kb: (j, 0)),
             ],
             out_specs=(
-                pl.BlockSpec((_BM, _CAND), lambda i, j, kb: (i, j)),
-                pl.BlockSpec((_BM, _CAND), lambda i, j, kb: (i, j)),
+                pl.BlockSpec(
+                    (_BM, _CAND), lambda i, j, kb: (j * n_bi + i, 0)
+                ),
+                pl.BlockSpec(
+                    (_BM, _CAND), lambda i, j, kb: (j * n_bi + i, 0)
+                ),
             ),
             scratch_shapes=[pltpu.VMEM((_BM, bn), jnp.float32)],
             **common,
         )(c_p, c_p, d_p, d_p)
 
-    # Exact reduction over the n_j*_CAND candidates per row. Candidate
-    # order is (tile, desc-value) with in-tile ties at ascending column;
+    # [n_j·n_pad, C] (stripe-major rows) → per-row candidate lists
+    # [n, n_j·C]. Candidate order after the transpose is (tile,
+    # desc-value) with in-tile ties at ascending column, so
     # chunked_row_topk's flat-top_k tie-break (lowest candidate index)
-    # therefore resolves equal values to the lowest global column.
+    # resolves equal values to the lowest global column.
+    vals = (
+        vals.reshape(n_j, n_pad, _CAND)
+        .transpose(1, 0, 2)
+        .reshape(n_pad, n_j * _CAND)
+    )
+    cols = (
+        cols.reshape(n_j, n_pad, _CAND)
+        .transpose(1, 0, 2)
+        .reshape(n_pad, n_j * _CAND)
+    )
     fv, fc = _sp.chunked_row_topk(vals[:n], cols[:n], k=k)
     return fv, fc
 

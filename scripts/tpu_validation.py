@@ -79,6 +79,23 @@ def main() -> int:
           bool(np.allclose(np.asarray(v_kt, dtype=np.float64), expect,
                            atol=1e-6)), "k=5, APA")
 
+    # -- two-pass top-k at a multi-stripe shape (n_j >= 2) ---------------
+    # dblp_small pads to ONE column stripe, which hides a whole class of
+    # Mosaic lowering constraints (block lane dim vs array lane dim) that
+    # interpret mode never checks; r03's bench child crashed exactly
+    # there. Small enough to stay cheap in quick mode.
+    rng = np.random.default_rng(11)
+    c2 = jnp.asarray(rng.integers(0, 3, (2304, 64)).astype(np.float32))
+    d2 = jnp.maximum(c2.sum(axis=1), 1.0)
+    v_tp, i_tp = pk.fused_topk_twopass(c2, d2, k=10)
+    v_sp, i_sp = pk.fused_topk(c2, d2, k=10)
+    check(
+        "twopass topk multi-stripe vs single-pass",
+        bool(np.array_equal(np.asarray(v_tp), np.asarray(v_sp)))
+        and bool(np.array_equal(np.asarray(i_tp), np.asarray(i_sp))),
+        "N=2304 (3 stripes), k=10",
+    )
+
     if quick:
         print("quick mode: skipping timing sweep", flush=True)
         return failures
