@@ -79,6 +79,28 @@ def main() -> int:
           bool(np.allclose(np.asarray(v_kt, dtype=np.float64), expect,
                            atol=1e-6)), "k=5, APA")
 
+    # -- precision contract: integer counts survive the MXU --------------
+    # _tile_dot claims precision=HIGHEST forces full-f32 passes; if a
+    # lowering ever silently downgraded to 1-pass bf16, products of
+    # counts ~1e3 (M entries ~1e8) would come back with ~4e-3 relative
+    # error instead of f32's ~1e-7. Probed on-chip because interpret
+    # mode computes in host f32 and can't see what the MXU does.
+    rng_p = np.random.default_rng(0)
+    cp_np = rng_p.integers(0, 1000, (1024, 384)).astype(np.float32)
+    cp = jnp.asarray(cp_np)
+    dp = jnp.maximum(cp.sum(axis=1), 1.0)
+    got_p = np.asarray(pk.fused_scores(cp, dp), dtype=np.float64)
+    c64 = cp_np.astype(np.float64)
+    d64 = np.maximum(c64.sum(axis=1), 1.0)
+    m64 = c64 @ c64.T
+    den = d64[:, None] + d64[None, :]
+    want_p = np.where(den > 0, 2 * m64 / np.where(den > 0, den, 1), 0.0)
+    rel = float(
+        np.max(np.abs(got_p - want_p) / np.maximum(np.abs(want_p), 1e-30))
+    )
+    check("fused_scores f32 precision at counts~1e8", rel <= 1e-5,
+          f"max rel err={rel:.2e} (bf16 1-pass would be ~4e-3)")
+
     # -- two-pass top-k at a multi-stripe shape (n_j >= 2) ---------------
     # dblp_small pads to ONE column stripe, which hides a whole class of
     # Mosaic lowering constraints (block lane dim vs array lane dim) that
