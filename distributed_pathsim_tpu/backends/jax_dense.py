@@ -112,11 +112,21 @@ class JaxDenseBackend(PathSimBackend):
             ]
         self._m = None
         self._rowsums = None
+        self._half_cache = None
 
     def _half(self):
-        """(C, rowsums) on device for a symmetric chain."""
-        rows, cols, weights = self._coo
-        return _half_outputs_coo(rows, cols, weights, self._c_shape)
+        """(C, rowsums) on device for a symmetric chain.
+
+        Cached: the factor is a per-graph constant, and on a tunneled
+        TPU every re-dispatch costs a ~70 ms RPC — repeated topk() calls
+        (rank-all driver loops, benchmark reps) should pay for the
+        scoring pass, not for re-assembling an immutable array."""
+        if self._half_cache is None:
+            rows, cols, weights = self._coo
+            self._half_cache = _half_outputs_coo(
+                rows, cols, weights, self._c_shape
+            )
+        return self._half_cache
 
     def _compute(self):
         if self._m is None:
@@ -166,10 +176,12 @@ class JaxDenseBackend(PathSimBackend):
                 scores = pk.fused_scores_ktiled(c, rowsums)
         else:
             scores = pk.fused_scores_reference(c, rowsums)
-        # Fetch + exactness check AFTER the kernel dispatch: dispatch is
-        # async, so the rowsum transfer rides along with the scoring pass.
-        self._rowsums = np.asarray(rowsums, dtype=np.float64)
-        self._check_exact(self._rowsums)
+        # Fetch + exactness check AFTER the kernel dispatch (async, so
+        # the transfer rides along) — and only once per backend: the
+        # rowsums are as immutable as the graph.
+        if self._rowsums is None:
+            self._rowsums = np.asarray(rowsums, dtype=np.float64)
+            self._check_exact(self._rowsums)
         return np.asarray(scores)
 
     def topk(self, k: int = 10, mask_self: bool = True):
@@ -194,6 +206,10 @@ class JaxDenseBackend(PathSimBackend):
                 n = scores.shape[0]
                 scores = jnp.where(jnp.eye(n, dtype=bool), -jnp.inf, scores)
             vals, idxs = jax.lax.top_k(scores, k)
-        self._rowsums = np.asarray(rowsums, dtype=np.float64)
-        self._check_exact(self._rowsums)
-        return np.asarray(vals), np.asarray(idxs)
+        if self._rowsums is None:
+            self._rowsums = np.asarray(rowsums, dtype=np.float64)
+            self._check_exact(self._rowsums)
+        # One batched transfer for both outputs: on the tunneled TPU two
+        # np.asarray fetches are two ~70 ms round-trips.
+        vals_h, idxs_h = jax.device_get((vals, idxs))
+        return np.asarray(vals_h), np.asarray(idxs_h)
