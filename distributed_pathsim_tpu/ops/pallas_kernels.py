@@ -531,6 +531,242 @@ def fused_topk_twopass(
     return fv, fc
 
 
+# ---------------------------------------------------------------------------
+# Rectangular two-pass top-k: one ROW TILE of sources against the whole
+# column range — the streaming tier's hot op (config 5: N up to millions,
+# V ≪ 128). The XLA fold it replaces (tiny-K GEMM + lax.top_k slabs per
+# [T, T] tile) measured ~5.5 s per 8192-row tile at N=1M on a v5e; the
+# MXU + packed-extraction kernel does the same row tile in one fused
+# sweep. Candidate layout: _GROUP column tiles pack their [bm, 16]
+# winners into ONE 128-lane block, so the HBM buffer has no lane-padding
+# blowup (a 16-lane minor dim is physically padded 8× by the (8,128)
+# HBM tile — see _TWOPASS_CAND_MAX_BYTES).
+# ---------------------------------------------------------------------------
+
+_GROUP = _HBM_LANE // _CAND  # column tiles per packed candidate block
+
+
+def _extract_group_topk(s, base_col, k: int, cand: int, g: int, buf_v, buf_c):
+    """Fold the top-``k+1`` of each row of masked score tile ``s`` into
+    lane segment ``g`` of the packed [bm, _GROUP·cand] candidate
+    buffers (same max-extract rounds and lowest-column tie-break as
+    _extract_tile_topk). k+1, not k: the caller drops self-pair
+    candidates AFTER extraction, and the tile containing a row's self
+    column must still contribute k non-self candidates — with only k
+    kept, a top-k that lives entirely in the self tile would lose its
+    k-th element."""
+    bm = s.shape[0]
+    lcols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    out_col = jax.lax.broadcasted_iota(jnp.int32, buf_v.shape, 1)
+    big = jnp.int32(2**30)
+    for t in range(min(k + 1, cand)):
+        vmax = jnp.max(s, axis=1, keepdims=True)
+        pos = jnp.min(jnp.where(s == vmax, lcols, big), axis=1, keepdims=True)
+        buf_v = jnp.where(out_col == g * cand + t, vmax, buf_v)
+        buf_c = jnp.where(out_col == g * cand + t, base_col + pos, buf_c)
+        s = jnp.where(lcols == pos, -jnp.inf, s)
+    return buf_v, buf_c
+
+
+def _topk2_rect_kernel(k: int, cand: int, bn: int, group: int, n_true: int,
+                       c_i_ref, c_j_ref, d_i_ref, d_j_ref, vals_ref,
+                       cols_ref):
+    """One [bm × group·bn] stripe: ``group`` MXU tile products, each
+    extracted into its packed lane segment. No self-masking here — the
+    caller excludes self-pairs on the candidate list (the k+1 kept
+    candidates keep that exact).
+
+    The group sweep is a ``fori_loop``, NOT a Python unroll: Mosaic
+    stack-allocates every unrolled iteration's score-tile temporaries
+    in scoped VMEM, and 8 unrolled groups × (k+1) extraction rounds
+    measured 18–20 MB of stack against the 16 MB v5e limit; the loop
+    keeps one iteration live."""
+    j = pl.program_id(1)
+    bm = c_i_ref.shape[0]
+    ci = c_i_ref[:]
+
+    def body(g, carry):
+        buf_v, buf_c = carry
+        cj = c_j_ref[pl.ds(g * bn, bn), :]
+        dj = d_j_ref[pl.ds(g * bn, bn), :]
+        s = _normalize(
+            jnp.dot(
+                ci,
+                cj.T,
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST,
+            ),
+            d_i_ref,
+            dj,
+        )
+        base_col = (j * group + g) * bn
+        cols = base_col + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(cols < n_true, s, -jnp.inf)
+        return _extract_group_topk(s, base_col, k, cand, g, buf_v, buf_c)
+
+    buf_v = jnp.full((bm, group * cand), -jnp.inf, dtype=jnp.float32)
+    buf_c = jnp.zeros((bm, group * cand), dtype=jnp.int32)
+    buf_v, buf_c = jax.lax.fori_loop(0, group, body, (buf_v, buf_c))
+    vals_ref[:] = buf_v
+    cols_ref[:] = buf_c
+
+
+# Column tile per group member. The original fully-unrolled kernel
+# blew the 16 MB VMEM stack at bn=512 (19.8 MB) AND bn=256 (18.0 MB) —
+# that's what forced the fori_loop, under which only one iteration's
+# score-tile temporaries are live. bn=256 is the value validated
+# on-chip with the loop; wider tiles are untried there, not impossible.
+_RECT_BN = 256
+# Candidate-buffer HBM budget (f32+i32, 128-lane packed — no lane
+# padding waste). Per row tile of T rows against N columns the buffer
+# is (n_pad/stripe)·t_pad rows × 128 lanes × 8 B = n_pad·(t_pad/16) B:
+# 4.3 GB at N=1M, tile_rows=8192 (measured to fit alongside dense C
+# and the reshape transients on a 16 GB v5e). The budget scales
+# inversely with tile_rows — larger N stays on the rect path by
+# choosing a smaller row tile.
+_RECT_CAND_MAX_BYTES = 4500 << 20
+
+
+def rect_supported(v: int, k: int) -> bool:
+    """The rectangular kernel keeps the whole [group·bn, v_pad] column
+    block in VMEM, so it serves the streaming regime's V ≪ N shapes
+    (v ≤ 128 after padding); self-exclusion on the candidate list needs
+    k < _CAND."""
+    return _ceil_to(max(v, 128), 128) <= 128 and k < _CAND
+
+
+def rect_pad_factor(c: jax.Array, d: jax.Array):
+    """Pad a [N, V] factor and its rowsums ONCE to the rect kernel's
+    expected [n_pad, 128] / [n_pad] shapes (stripe-aligned rows, 128
+    lanes), so per-row-tile kernel calls skip the O(N·128) re-pad."""
+    n, v = c.shape
+    stripe = _GROUP * _RECT_BN
+    n_pad = _ceil_to(max(n, 8), stripe)
+    cc = jnp.zeros((n_pad, 128), dtype=jnp.float32).at[:n, :v].set(c)
+    dc = jnp.zeros((n_pad,), dtype=jnp.float32).at[:n].set(d)
+    return cc, dc
+
+
+def rect_fits(n_cols: int, tile_rows: int) -> bool:
+    """True when one row tile's packed candidate buffer fits the HBM
+    budget (the rect analog of :func:`twopass_fits` — without it a
+    large-N rank-all would OOM mid-pass instead of taking the fold
+    path)."""
+    stripe = _GROUP * _RECT_BN
+    n_pad = _ceil_to(max(n_cols, 8), stripe)
+    t_pad = _ceil_to(max(tile_rows, 8), _BM)
+    cand_bytes = (n_pad // stripe) * t_pad * _HBM_LANE * 8
+    return cand_bytes <= _RECT_CAND_MAX_BYTES
+
+
+@functools.partial(
+    jax.jit, static_argnames=("k", "n_true_cols", "interpret")
+)
+def fused_topk_twopass_rect(
+    c_rows: jax.Array,
+    c_cols: jax.Array,
+    d_rows: jax.Array,
+    d_cols: jax.Array,
+    row_ids: jax.Array,
+    k: int = 10,
+    n_true_cols: int | None = None,
+    interpret: bool = False,
+):
+    """Exact per-row top-k of the [T, N] score block
+    ``S = 2·(c_rows @ c_colsᵀ) / (d_rows ⊕ d_cols)`` with self-pairs
+    excluded, never materializing S.
+
+    c_rows: [T, V] row-tile factor; c_cols: [N, V] full factor;
+    d_rows/d_cols: matching rowsums; row_ids: [T] int32 global row
+    indices (self-exclusion: any candidate whose column equals its
+    row's global id is dropped on the candidate list — exact because
+    each tile keeps _CAND > k candidates). Requires rect_supported(V, k).
+    """
+    t, v = c_rows.shape
+    n, _ = c_cols.shape
+    if not rect_supported(v, k):
+        raise ValueError("fused_topk_twopass_rect requires V<=128, k<16")
+    if n_true_cols is None:
+        n_true_cols = n
+    bn = _RECT_BN
+    stripe = _GROUP * bn
+    t_pad = _ceil_to(max(t, 8), _BM)
+    n_pad = _ceil_to(max(n, 8), stripe)
+    v_pad = 128
+    # Skip the pads when the caller hands kernel-shaped arrays (the
+    # streaming backend pre-pads its cached dense C once): re-padding
+    # the full column factor here would re-execute an O(N·128) copy on
+    # every per-row-tile call.
+    if c_rows.shape == (t_pad, v_pad) and c_rows.dtype == jnp.float32:
+        cr = c_rows
+    else:
+        cr = (
+            jnp.zeros((t_pad, v_pad), dtype=jnp.float32)
+            .at[:t, :v].set(c_rows)
+        )
+    if c_cols.shape == (n_pad, v_pad) and c_cols.dtype == jnp.float32:
+        cc = c_cols
+    else:
+        cc = (
+            jnp.zeros((n_pad, v_pad), dtype=jnp.float32)
+            .at[:n, :v].set(c_cols)
+        )
+    if d_rows.shape == (t_pad,) and d_rows.dtype == jnp.float32:
+        dr = d_rows.reshape(t_pad, 1)
+    else:
+        dr = jnp.zeros((t_pad, 1), dtype=jnp.float32).at[:t, 0].set(d_rows)
+    if d_cols.shape == (n_pad,) and d_cols.dtype == jnp.float32:
+        dc = d_cols.reshape(n_pad, 1)
+    else:
+        dc = jnp.zeros((n_pad, 1), dtype=jnp.float32).at[:n, 0].set(d_cols)
+
+    n_bi = t_pad // _BM
+    n_js = n_pad // stripe
+    vals, cols = pl.pallas_call(
+        functools.partial(
+            _topk2_rect_kernel, k, _CAND, bn, _GROUP, n_true_cols
+        ),
+        grid=(n_bi, n_js),
+        in_specs=[
+            pl.BlockSpec((_BM, v_pad), lambda i, j: (i, 0)),
+            pl.BlockSpec((stripe, v_pad), lambda i, j: (j, 0)),
+            pl.BlockSpec((_BM, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((stripe, 1), lambda i, j: (j, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec(
+                (_BM, _GROUP * _CAND), lambda i, j: (j * n_bi + i, 0)
+            ),
+            pl.BlockSpec(
+                (_BM, _GROUP * _CAND), lambda i, j: (j * n_bi + i, 0)
+            ),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((n_js * t_pad, _GROUP * _CAND), jnp.float32),
+            jax.ShapeDtypeStruct((n_js * t_pad, _GROUP * _CAND), jnp.int32),
+        ),
+        interpret=interpret,
+    )(cr, cc, dr, dc)
+
+    width = n_js * _GROUP * _CAND
+    vals = (
+        vals.reshape(n_js, t_pad, _GROUP * _CAND)
+        .transpose(1, 0, 2)
+        .reshape(t_pad, width)[:t]
+    )
+    cols = (
+        cols.reshape(n_js, t_pad, _GROUP * _CAND)
+        .transpose(1, 0, 2)
+        .reshape(t_pad, width)[:t]
+    )
+    # Self-exclusion on the candidate list (exact: each tile kept
+    # _CAND > k candidates, so dropping one leaves >= k).
+    vals = jnp.where(cols == row_ids[:, None], -jnp.inf, vals)
+    from . import sparse as _sp
+
+    return _sp.chunked_row_topk(vals, cols, k=k)
+
+
 def pallas_supported() -> bool:
     """Pallas TPU kernels need a real TPU backend; elsewhere callers use
     interpret mode (tests) or the XLA reference."""

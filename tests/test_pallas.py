@@ -237,6 +237,113 @@ def test_twopass_multi_stripe_layout():
         )
 
 
+def test_rect_twopass_matches_reference():
+    """The rectangular (row-tile × full-column-range) kernel: values and
+    indices vs a dense f64 recomputation, self-pairs excluded, at a
+    shape with several packed stripes and padded tail columns."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(3)
+    n, v, tile, k = 9000, 64, 512, 7  # n_pad -> 3 stripes of 4096
+    c = rng.integers(0, 3, (n, v)).astype(np.float32)
+    d = np.maximum(c.sum(axis=1), 1.0)
+    c64 = c.astype(np.float64)
+    m = c64 @ c64.T
+    den = d[:, None] + d[None, :]
+    ref = np.where(den > 0, 2 * m / np.where(den > 0, den, 1), 0.0)
+    np.fill_diagonal(ref, -np.inf)
+
+    i0 = 4096  # a row tile straddling nothing special; rows 4096..4607
+    vals, idxs = pk.fused_topk_twopass_rect(
+        jnp.asarray(c[i0 : i0 + tile]), jnp.asarray(c),
+        jnp.asarray(d[i0 : i0 + tile], dtype=jnp.float32),
+        jnp.asarray(d, dtype=jnp.float32),
+        i0 + jnp.arange(tile, dtype=jnp.int32),
+        k=k, interpret=True,
+    )
+    for r in (0, 1, 255, 511):
+        expect = np.sort(ref[i0 + r])[::-1][:k]
+        np.testing.assert_allclose(
+            np.asarray(vals[r], dtype=np.float64), expect, atol=1e-6
+        )
+        np.testing.assert_allclose(
+            ref[i0 + r][np.asarray(idxs[r])], expect, atol=1e-6
+        )
+        assert i0 + r not in np.asarray(idxs[r])  # self excluded
+
+
+def test_rect_twopass_self_tile_keeps_k():
+    """k+1 extraction rounds: when a row's entire non-self top-k lives
+    in the SAME packed tile as its self column, dropping the self
+    candidate must still leave k exact winners."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(5)
+    n, v, k = 512, 8, 5
+    # One dominant venue: every row's best matches live at low column
+    # ids — the same 512-wide tile that holds the self column.
+    c = np.zeros((n, v), dtype=np.float32)
+    c[:, 0] = rng.integers(1, 4, n)
+    d = np.maximum(c.sum(axis=1), 1.0)
+    c64 = c.astype(np.float64)
+    m = c64 @ c64.T
+    den = d[:, None] + d[None, :]
+    ref = np.where(den > 0, 2 * m / np.where(den > 0, den, 1), 0.0)
+    np.fill_diagonal(ref, -np.inf)
+    vals, idxs = pk.fused_topk_twopass_rect(
+        jnp.asarray(c), jnp.asarray(c),
+        jnp.asarray(d, dtype=jnp.float32), jnp.asarray(d, dtype=jnp.float32),
+        jnp.arange(n, dtype=jnp.int32), k=k, interpret=True,
+    )
+    for r in (0, 100, 511):
+        expect = np.sort(ref[r])[::-1][:k]
+        np.testing.assert_allclose(
+            np.asarray(vals[r], dtype=np.float64), expect, atol=1e-6
+        )
+        assert r not in np.asarray(idxs[r])
+
+
+def test_rect_supported_gates():
+    assert pk.rect_supported(64, 10)
+    assert pk.rect_supported(128, 15)
+    assert not pk.rect_supported(129, 10)  # two VMEM K-blocks
+    assert not pk.rect_supported(64, 16)   # no self-exclusion headroom
+
+
+def test_rect_fits_budget():
+    # Candidate buffer = n_pad·(t_pad/16) bytes: 4.3 GB at 1M×8192
+    # (measured to fit a 16 GB v5e), over budget at 2M×8192 — but a
+    # smaller row tile brings the same N back under.
+    assert pk.rect_fits(1_048_576, 8192)
+    assert not pk.rect_fits(2_097_152, 8192)
+    assert pk.rect_fits(2_097_152, 4096)
+
+
+def test_rect_prepadded_factor_matches_unpadded():
+    """The pad-once fast path (kernel-shaped inputs skip the internal
+    pad) must return the same winners as handing raw arrays."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(23)
+    n, v, tile, k = 3000, 48, 256, 5
+    c = rng.integers(0, 3, (n, v)).astype(np.float32)
+    d = np.maximum(c.sum(axis=1), 1.0).astype(np.float32)
+    cc, dc = pk.rect_pad_factor(jnp.asarray(c), jnp.asarray(d))
+    i0 = 1024
+    ids = i0 + jnp.arange(tile, dtype=jnp.int32)
+    v1, i1 = pk.fused_topk_twopass_rect(
+        cc[i0 : i0 + tile], cc, dc[i0 : i0 + tile], dc, ids,
+        k=k, n_true_cols=n, interpret=True,
+    )
+    v2, i2 = pk.fused_topk_twopass_rect(
+        jnp.asarray(c[i0 : i0 + tile]), jnp.asarray(c),
+        jnp.asarray(d[i0 : i0 + tile]), jnp.asarray(d), ids,
+        k=k, interpret=True,
+    )
+    np.testing.assert_array_equal(np.asarray(v1), np.asarray(v2))
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+
 def test_twopass_rejects_large_k(cd):
     c, d, _ = cd
     with pytest.raises(ValueError):

@@ -58,6 +58,43 @@ def test_streaming_topk(dblp_small_hin, mp, oracle):
         np.testing.assert_allclose(vals[i], expect)
 
 
+def test_scanned_sweep_equals_per_tile_sweep(dblp_small_hin, mp):
+    """The lax.scan column sweep (one dispatch per row tile; default
+    whenever dense C fits the device budget) must match the per-(i,j)
+    dispatch loop bit-for-bit — same fold order, same tie-breaks."""
+    scanned = create_backend("jax-sparse", dblp_small_hin, mp, tile_rows=128)
+    assert scanned.tiled.dense_bytes() <= scanned._dense_c_budget
+    tiled = create_backend(
+        "jax-sparse", dblp_small_hin, mp, tile_rows=128,
+        dense_c_budget_bytes=0,  # force the per-tile path
+    )
+    v1, i1 = scanned.topk_scores(k=5)
+    v2, i2 = tiled.topk_scores(k=5)
+    np.testing.assert_array_equal(v1, v2)
+    np.testing.assert_array_equal(i1, i2)
+
+
+def test_rect_kernel_streaming_equals_fold_paths():
+    """rect_kernel=True (the real-TPU streaming fast path, interpret
+    mode here) must agree with both fold paths on values, and on
+    indices wherever scores are distinct."""
+    from distributed_pathsim_tpu.data.synthetic import synthetic_hin
+
+    hin = synthetic_hin(700, 1200, 32, seed=13)
+    mp2 = compile_metapath("APVPA", hin.schema)
+    import jax.numpy as jnp
+
+    kw = dict(tile_rows=256, dtype=jnp.float32, exact_counts=False)
+    rect = create_backend("jax-sparse", hin, mp2, rect_kernel=True, **kw)
+    assert rect._use_rect_kernel(5)
+    fold = create_backend("jax-sparse", hin, mp2, rect_kernel=False, **kw)
+    v1, i1 = rect.topk_scores(k=5)
+    v2, i2 = fold.topk_scores(k=5)
+    np.testing.assert_allclose(v1, v2, atol=1e-6)
+    distinct = np.ptp(v1, axis=1) > 1e-9
+    np.testing.assert_array_equal(i1[distinct], i2[distinct])
+
+
 def test_synthetic_sparse_vs_dense():
     from distributed_pathsim_tpu.data.synthetic import synthetic_hin
 
