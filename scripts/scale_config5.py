@@ -97,6 +97,12 @@ def main(argv=None) -> dict:
     from distributed_pathsim_tpu.backends.base import create_backend
     from distributed_pathsim_tpu.data.synthetic import synthetic_hin
     from distributed_pathsim_tpu.ops.metapath import compile_metapath
+    from distributed_pathsim_tpu.utils.xla_flags import enable_compile_cache
+
+    # Remote compiles through the TPU tunnel cost tens of seconds per
+    # program; the persistent cache makes reruns (and crash-resume)
+    # start ranking immediately (bench.py does the same).
+    enable_compile_cache()
 
     t0 = time.perf_counter()
     hin = synthetic_hin(args.authors, args.papers, args.venues, seed=42)
