@@ -39,10 +39,27 @@ def parse_args(argv=None):
     p.add_argument("--repeats", type=int, default=_headline.REPS)
     p.add_argument(
         "--backends",
-        default="jax,jax-sharded,jax-sparse",
-        help="comma-separated backend tiers to measure",
+        default=None,
+        help="comma-separated backend tiers to measure (default depends "
+        "on --platform)",
     )
-    return p.parse_args(argv)
+    p.add_argument(
+        "--platform",
+        default="cpu",
+        choices=("cpu", "tpu"),
+        help="cpu (default): provision a virtual CPU mesh for the "
+        "distributed tiers. tpu: run the single-device tiers on the "
+        "real chip (ONE client at a time on this box — see bench.py's "
+        "tunnel protocol; jax-sharded is excluded, the box has one "
+        "chip)",
+    )
+    args = p.parse_args(argv)
+    if args.backends is None:
+        args.backends = (
+            "jax,jax-sparse" if args.platform == "tpu"
+            else "jax,jax-sharded,jax-sparse"
+        )
+    return args
 
 
 def _ensure_devices(n: int) -> str:
@@ -102,7 +119,23 @@ def bench_backend(name: str, hin, mp, k: int, repeats: int, n_devices: int):
 
 def main(argv=None) -> None:
     args = parse_args(argv)
-    platform = _ensure_devices(args.devices)
+    if args.platform == "tpu":
+        import jax
+
+        from distributed_pathsim_tpu.utils.xla_flags import (
+            enable_compile_cache,
+        )
+
+        enable_compile_cache()
+        dev = jax.devices()[0]  # may hang if the tunnel is wedged —
+        # callers follow bench.py's protocol (self-alarming child)
+        if dev.platform != "tpu":
+            raise RuntimeError(
+                f"--platform tpu but JAX resolved to {dev.platform}"
+            )
+        platform = "tpu"
+    else:
+        platform = _ensure_devices(args.devices)
 
     from distributed_pathsim_tpu.data.synthetic import synthetic_hin
     from distributed_pathsim_tpu.ops.metapath import compile_metapath

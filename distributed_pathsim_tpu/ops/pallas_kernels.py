@@ -627,22 +627,31 @@ _RECT_BN = 256
 _RECT_CAND_MAX_BYTES = 4500 << 20
 
 
+# Widest contraction the rect kernel holds un-tiled: the [group·bn,
+# v_pad] column stripe is a 4 MB VMEM block at 512 — comfortable now
+# that the group sweep is a fori_loop (one iteration's temporaries
+# live). Covers every shipped config (64-venue config 5, the 384-venue
+# canonical bench shape); wider factors fall back to the scan fold.
+_RECT_VMAX = 512
+
+
 def rect_supported(v: int, k: int) -> bool:
     """The rectangular kernel keeps the whole [group·bn, v_pad] column
-    block in VMEM, so it serves the streaming regime's V ≪ N shapes
-    (v ≤ 128 after padding); self-exclusion on the candidate list needs
-    k < _CAND."""
-    return _ceil_to(max(v, 128), 128) <= 128 and k < _CAND
+    block in VMEM, so it serves V ≪ N shapes (v ≤ _RECT_VMAX after
+    padding); self-exclusion on the candidate list needs k < _CAND."""
+    return _ceil_to(max(v, 128), 128) <= _RECT_VMAX and k < _CAND
 
 
 def rect_pad_factor(c: jax.Array, d: jax.Array):
     """Pad a [N, V] factor and its rowsums ONCE to the rect kernel's
-    expected [n_pad, 128] / [n_pad] shapes (stripe-aligned rows, 128
-    lanes), so per-row-tile kernel calls skip the O(N·128) re-pad."""
+    expected [n_pad, v_pad] / [n_pad] shapes (stripe-aligned rows,
+    lane-aligned columns), so per-row-tile kernel calls skip the
+    O(N·v_pad) re-pad."""
     n, v = c.shape
     stripe = _GROUP * _RECT_BN
     n_pad = _ceil_to(max(n, 8), stripe)
-    cc = jnp.zeros((n_pad, 128), dtype=jnp.float32).at[:n, :v].set(c)
+    v_pad = _ceil_to(max(v, 128), 128)
+    cc = jnp.zeros((n_pad, v_pad), dtype=jnp.float32).at[:n, :v].set(c)
     dc = jnp.zeros((n_pad,), dtype=jnp.float32).at[:n].set(d)
     return cc, dc
 
@@ -685,14 +694,16 @@ def fused_topk_twopass_rect(
     t, v = c_rows.shape
     n, _ = c_cols.shape
     if not rect_supported(v, k):
-        raise ValueError("fused_topk_twopass_rect requires V<=128, k<16")
+        raise ValueError(
+            f"fused_topk_twopass_rect requires V<={_RECT_VMAX}, k<{_CAND}"
+        )
     if n_true_cols is None:
         n_true_cols = n
     bn = _RECT_BN
     stripe = _GROUP * bn
     t_pad = _ceil_to(max(t, 8), _BM)
     n_pad = _ceil_to(max(n, 8), stripe)
-    v_pad = 128
+    v_pad = _ceil_to(max(v, 128), 128)
     # Skip the pads when the caller hands kernel-shaped arrays (the
     # streaming backend pre-pads its cached dense C once): re-padding
     # the full column factor here would re-execute an O(N·128) copy on

@@ -145,6 +145,28 @@ def main() -> int:
     check("rect twopass vs dense f64 (self excluded)", ok_rect,
           f"N={n_r}, tile={tile_r}, k={k_r}")
 
+    # Same kernel at the canonical 384-venue width (multi-128-lane
+    # v_pad; VMEM-sized differently — worth its own on-chip compile).
+    cw_np = rng2.integers(0, 2, (4000, 384)).astype(np.float32)
+    dw_np = np.maximum(cw_np.sum(axis=1), 1.0)
+    cw64 = cw_np.astype(np.float64)
+    mw = cw64 @ cw64.T
+    denw = dw_np[:, None] + dw_np[None, :]
+    refw = np.where(denw > 0, 2 * mw / np.where(denw > 0, denw, 1), 0.0)
+    np.fill_diagonal(refw, -np.inf)
+    vw, iw = pk.fused_topk_twopass_rect(
+        jnp.asarray(cw_np[:512]), jnp.asarray(cw_np),
+        jnp.asarray(dw_np[:512], dtype=jnp.float32),
+        jnp.asarray(dw_np, dtype=jnp.float32),
+        jnp.arange(512, dtype=jnp.int32), k=10,
+    )
+    ok_w = all(
+        bool(np.allclose(np.asarray(vw[r], dtype=np.float64),
+                         np.sort(refw[r])[::-1][:10], atol=1e-6))
+        for r in (0, 511)
+    )
+    check("rect twopass wide-V (384) vs dense f64", ok_w, "N=4000, k=10")
+
     if quick:
         print("quick mode: skipping timing sweep", flush=True)
         return failures

@@ -305,9 +305,38 @@ def test_rect_twopass_self_tile_keeps_k():
 
 def test_rect_supported_gates():
     assert pk.rect_supported(64, 10)
-    assert pk.rect_supported(128, 15)
-    assert not pk.rect_supported(129, 10)  # two VMEM K-blocks
+    assert pk.rect_supported(384, 10)      # canonical bench width
+    assert pk.rect_supported(512, 15)
+    assert not pk.rect_supported(513, 10)  # stripe block exceeds VMEM
     assert not pk.rect_supported(64, 16)   # no self-exclusion headroom
+
+
+def test_rect_twopass_wide_contraction():
+    """V=384 (the canonical bench width) exercises the multi-128-lane
+    v_pad path."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(31)
+    n, v, tile, k = 2500, 384, 256, 6
+    c = rng.integers(0, 2, (n, v)).astype(np.float32)
+    d = np.maximum(c.sum(axis=1), 1.0)
+    c64 = c.astype(np.float64)
+    m = c64 @ c64.T
+    den = d[:, None] + d[None, :]
+    ref = np.where(den > 0, 2 * m / np.where(den > 0, den, 1), 0.0)
+    np.fill_diagonal(ref, -np.inf)
+    i0 = 512
+    vals, idxs = pk.fused_topk_twopass_rect(
+        jnp.asarray(c[i0 : i0 + tile]), jnp.asarray(c),
+        jnp.asarray(d[i0 : i0 + tile], dtype=jnp.float32),
+        jnp.asarray(d, dtype=jnp.float32),
+        i0 + jnp.arange(tile, dtype=jnp.int32), k=k, interpret=True,
+    )
+    for r in (0, 128, 255):
+        expect = np.sort(ref[i0 + r])[::-1][:k]
+        np.testing.assert_allclose(
+            np.asarray(vals[r], dtype=np.float64), expect, atol=1e-6
+        )
 
 
 def test_rect_fits_budget():
