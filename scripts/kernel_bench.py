@@ -210,6 +210,53 @@ def main() -> int:
              "kernels": entries}
         )
 
+    # -- streaming hot op: rectangular two-pass (row tile × full range) --
+    # Its own section because the shape is different in kind: [T, V]
+    # sources against [N, V] targets with V ≪ 128-lane padding — the
+    # config-5 regime. FLOPs counted = 2·T·N·v_pad (the MXU work the
+    # kernel actually issues on the padded factor).
+    if not args.quick and dev.platform == "tpu":  # no interpret fallback
+        t_rows, n_cols, v_str = 8192, 131072, 64
+        cs = jax.random.randint(
+            jax.random.PRNGKey(1), (n_cols, v_str), 0, 3
+        ).astype(jnp.float32)
+        ds = jnp.maximum(cs.sum(axis=1), 1.0)
+        cc, dc = pk.rect_pad_factor(cs, ds)
+        cc_variants = [cc + (i * 1e-38) for i in range(4)]
+        jax.block_until_ready(cc_variants)
+        row_ids = jnp.arange(t_rows, dtype=jnp.int32)
+
+        def rect_scalar(cc_, dc_):
+            v_, _ = pk.fused_topk_twopass_rect(
+                jax.lax.dynamic_slice(
+                    cc_, (0, 0), (t_rows, cc_.shape[1])
+                ),
+                cc_,
+                jax.lax.dynamic_slice(dc_, (0,), (t_rows,)),
+                dc_,
+                row_ids,
+                k=10,
+                n_true_cols=n_cols,
+            )
+            return jnp.max(v_)
+
+        e = _per_call(rect_scalar, cc_variants, dc, r1=1, r2=3, reps=3)
+        v_pad = cc.shape[1]
+        flops = 2.0 * t_rows * n_cols * v_pad
+        e["achieved_tflops"] = flops / (e["per_call_ms"] / 1e3) / 1e12
+        e["pairs_per_sec"] = t_rows * n_cols / (e["per_call_ms"] / 1e3)
+        result["streaming_rect"] = {
+            "t_rows": t_rows, "n_cols": n_cols, "v": v_str,
+            "kernel": "fused_topk_twopass_rect", "k": 10,
+            **e,
+        }
+        print(
+            f"# rect[{t_rows}x{n_cols}] v={v_str}: "
+            f"{e['per_call_ms']:.1f}ms "
+            f"({e['pairs_per_sec']:.3g} pairs/s)",
+            file=sys.stderr, flush=True,
+        )
+
     doc = json.dumps(result, indent=1)
     print(doc, flush=True)
     if args.out:
