@@ -32,6 +32,8 @@ def main(argv=None) -> dict:
     p.add_argument("--venues", type=int, default=64)
     p.add_argument("--steps", type=int, default=300)
     p.add_argument("--batch", type=int, default=8192)
+    p.add_argument("--dim", type=int, default=64)
+    p.add_argument("--hidden", type=int, default=128)
     p.add_argument("--eval-sources", type=int, default=50)
     p.add_argument("--top-k", type=int, default=10)
     p.add_argument("--platform", default="tpu", choices=("cpu", "tpu"))
@@ -52,7 +54,7 @@ def main(argv=None) -> dict:
         raise RuntimeError(f"--platform tpu but JAX resolved to {dev.platform}")
 
     hin = synthetic_hin(args.authors, args.papers, args.venues, seed=42)
-    model = NeuralPathSim(hin, "APVPA")
+    model = NeuralPathSim(hin, "APVPA", dim=args.dim, hidden=args.hidden)
 
     t0 = time.perf_counter()
     losses = model.train(steps=args.steps, batch_size=args.batch, seed=0)
