@@ -286,13 +286,15 @@ def stream_row_tile_topk(c_all, d_all, i0, k: int, n_true: int,
     """One row tile's top-k in ONE dispatch: ``lax.scan`` the shared
     fold over every column tile of the device-resident dense C.
 
-    The per-(i, j) dispatch loop costs n_tiles² host→device round
-    trips; through a tunneled TPU (~70 ms each) that latency — not the
-    GEMMs — dominated the million-author pass (measured 5.9 s per row
-    tile where the compute is ~0.5 s). With the column sweep inside
-    jit, the whole pass makes n_tiles dispatches. Requires dense C on
-    device (caller gates on its byte size); identical fold order and
-    numerics to the per-tile path by construction.
+    Cuts the per-(i, j) dispatch loop's n_tiles² host→device round
+    trips to n_tiles — but measured only 756 s → 740 s at N=1M on the
+    tunneled v5e: the pass is compute-bound in this fold's tiny-K GEMM
+    + ``lax.top_k`` slab sorts, which is what motivated the rectangular
+    Pallas kernel (162 s; ``pallas_kernels.fused_topk_twopass_rect``,
+    DESIGN.md §11). Kept as the general-dtype / wide-V fallback.
+    Requires dense C on device (caller gates on its byte size);
+    identical fold order and numerics to the per-tile path by
+    construction.
     """
     n_pad, _ = c_all.shape
     n_tiles = n_pad // tile_rows
