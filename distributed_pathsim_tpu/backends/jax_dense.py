@@ -192,7 +192,7 @@ class JaxDenseBackend(PathSimBackend):
         if self.use_pallas and k <= pk._CAND and pk.twopass_fits(c.shape[0]):
             # Fastest path: candidate extraction + XLA reduce (handles
             # any V internally). Beyond the candidate-buffer HBM budget
-            # (~256k rows) the fold kernel below takes over.
+            # (~92k rows — twopass_fits) the fold kernel takes over.
             vals, idxs = pk.fused_topk_twopass(
                 c, rowsums, k=k, mask_self=mask_self
             )

@@ -356,6 +356,12 @@ class JaxSparseBackend(PathSimBackend):
                 prev_key = max(
                     snaps, key=lambda s: int(s[len(self._PARTIALS_PREFIX):])
                 )
+                # A crash between save_unit(new) and drop_unit(prev)
+                # leaves an older snapshot behind (~80 MB each at 1M
+                # authors) — resume keeps only the newest.
+                for stale in snaps:
+                    if stale != prev_key:
+                        ckpt.drop_unit(stale)
                 after = int(prev_key[len(self._PARTIALS_PREFIX):])
                 part = ckpt.load_unit(prev_key)
                 # Rows ≤ after were saved before the snapshot (ordering

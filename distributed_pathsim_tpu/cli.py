@@ -71,9 +71,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--approx",
         action="store_true",
-        help="jax-sparse: waive the f32 exact-integer-count guard for "
-        "graphs whose path counts exceed 2^24 (scores stay within the "
-        "1e-5 gate; only the guard is waived)",
+        help="jax / jax-sparse: waive the f32 exact-integer-count guard "
+        "for graphs whose path counts exceed 2^24 (scores stay within "
+        "the 1e-5 gate; only the guard is waived)",
     )
     p.add_argument("--source", default=None, help="source node label (e.g. author name)")
     p.add_argument("--source-id", default=None, help="source node id (e.g. author_395340)")
@@ -266,10 +266,15 @@ def _run(args) -> int:
                 "--ranking-out/--checkpoint-dir require --top-k "
                 "(the all-sources ranking mode)"
             )
-    if (args.tile_rows is not None or args.approx) and args.backend != "jax-sparse":
+    if args.tile_rows is not None and args.backend != "jax-sparse":
         raise ValueError(
-            "--tile-rows/--approx tune the streaming tiled path and "
-            "require --backend jax-sparse"
+            "--tile-rows tunes the streaming tiled path and requires "
+            "--backend jax-sparse"
+        )
+    if args.approx and args.backend not in ("jax", "jax-sparse"):
+        raise ValueError(
+            "--approx waives the f32 exact-count guard of the device "
+            "backends (jax, jax-sparse); the numpy oracle is f64-exact"
         )
     config = RunConfig(
         dataset=args.dataset,

@@ -31,14 +31,26 @@ sys.path.insert(0, ".")
 
 
 def _provision(n: int) -> None:
+    """Force >= n virtual CPU devices, restoring XLA_FLAGS once XLA has
+    parsed it (first jax.devices() call) so the forced count never leaks
+    into later subprocesses doing real single-chip work — same
+    discipline as bench_backends._ensure_devices."""
     import os
 
     from distributed_pathsim_tpu.utils.xla_flags import device_flags_value
 
+    prev = os.environ.get("XLA_FLAGS")
     os.environ["XLA_FLAGS"] = device_flags_value(n)
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.devices()
+    finally:
+        if prev is None:
+            os.environ.pop("XLA_FLAGS", None)
+        else:
+            os.environ["XLA_FLAGS"] = prev
 
 
 def _timed(fn, reps: int = 5) -> float:

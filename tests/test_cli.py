@@ -283,6 +283,26 @@ def test_sparse_knobs_plumb_through(dblp_small_path, tmp_path, capsys):
     assert len(out.read_text().splitlines()) > 700
 
 
+def test_approx_allowed_for_dense_jax(dblp_small_path, capsys):
+    # The dense backend's approx mode (million-author dense-resident
+    # regime) must be reachable from the product path too.
+    rc = main([
+        "--dataset", dblp_small_path, "--backend", "jax", "--approx",
+        "--source", "Didier Dubois", "--top-k", "2", "--quiet",
+    ])
+    assert rc == 0
+    assert "Salem Benferhat" in capsys.readouterr().out
+
+
+def test_approx_rejected_for_numpy(dblp_small_path, capsys):
+    rc = main([
+        "--dataset", dblp_small_path, "--backend", "numpy", "--approx",
+        "--source", "Didier Dubois", "--quiet",
+    ])
+    assert rc == 1
+    assert "f64-exact" in capsys.readouterr().err
+
+
 def test_multihost_rejects_non_sharded_backend(dblp_small_path, capsys):
     rc = main([
         "--dataset", dblp_small_path, "--backend", "jax",
