@@ -258,6 +258,36 @@ def test_symmetric_sweep_resumes_after_crash(dblp_small_hin, tmp_path, monkeypat
     ) == 1
 
 
+def test_symmetric_resume_drops_stale_snapshots(dblp_small_hin, tmp_path):
+    """A crash between save_unit(new snapshot) and drop_unit(previous)
+    leaves two snapshots behind; the next resume must keep only the
+    newest and drop the stale one (each leak is ~80 MB at 1M scale)."""
+    from distributed_pathsim_tpu.utils.checkpoint import CheckpointManager
+
+    ck = str(tmp_path / "ck")
+    b = _sparse_backend(dblp_small_hin)
+    want_v, want_i = b.topk_scores(k=3, checkpoint_dir=ck, symmetric=True)
+    # Forge the crash aftermath: an OLDER snapshot alongside the final one.
+    mgr = CheckpointManager(ck)
+    final = [d for d in mgr.done_keys() if d.startswith("sym_partials_")]
+    assert len(final) == 1
+    mgr.save_unit(
+        "sym_partials_after_0",
+        vals=np.zeros((1, 256, 3)),
+        idxs=np.zeros((1, 256, 3), dtype=np.int32),
+    )
+    got_v, got_i = _sparse_backend(dblp_small_hin).topk_scores(
+        k=3, checkpoint_dir=ck, symmetric=True
+    )
+    np.testing.assert_array_equal(want_v, got_v)
+    np.testing.assert_array_equal(want_i, got_i)
+    left = [
+        d for d in CheckpointManager(ck).done_keys()
+        if d.startswith("sym_partials_")
+    ]
+    assert left == final  # stale snapshot dropped, newest kept
+
+
 def test_symmetric_sweep_resumes_without_snapshot(dblp_small_hin, tmp_path):
     """A crash before the first partials snapshot restarts from scratch
     and still produces correct results (row units are overwritten)."""
