@@ -20,26 +20,40 @@ def _bench():
     return importlib.reload(bench)
 
 
+def _flag_of(popen_args):
+    """Which child was spawned: bench._PROBE_FLAG or bench._CHILD_FLAG
+    (the flag is the last element of the argv list)."""
+    return popen_args[0][-1]
+
+
 def test_cpu_env_skips_tpu_attempt(monkeypatch):
     bench = _bench()
     monkeypatch.setenv("JAX_PLATFORMS", "cpu")
     called = []
-    monkeypatch.setattr(bench, "_cpu_fallback", lambda: called.append(1))
+    monkeypatch.setattr(bench, "_cpu_fallback",
+                        lambda reason: called.append(reason))
     monkeypatch.setattr(
         bench.subprocess, "Popen",
         lambda *a, **k: (_ for _ in ()).throw(AssertionError("spawned")),
     )
     bench.main()
-    assert called == [1]
+    assert called == ["forced_cpu_env"]
 
 
 def test_successful_child_json_is_forwarded(monkeypatch, capsys):
+    """Healthy probe, then the bench child's JSON line is forwarded."""
     bench = _bench()
     monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    spawned = []
 
     class Ok:
         def __init__(self, *a, stdout=None, **k):
-            stdout.write('{"metric": "m", "value": 1.0}\n')
+            flag = _flag_of(a)
+            spawned.append(flag)
+            if flag == bench._PROBE_FLAG:
+                stdout.write("# probe ok: FakeTpu\n")
+            else:
+                stdout.write('{"metric": "m", "value": 1.0}\n')
             stdout.flush()
 
         def poll(self):
@@ -48,23 +62,26 @@ def test_successful_child_json_is_forwarded(monkeypatch, capsys):
     monkeypatch.setattr(bench.subprocess, "Popen", Ok)
     monkeypatch.setattr(
         bench, "_cpu_fallback",
-        lambda: (_ for _ in ()).throw(AssertionError("fell back")),
+        lambda reason: (_ for _ in ()).throw(AssertionError("fell back")),
     )
     bench.main()
     assert capsys.readouterr().out.strip() == '{"metric": "m", "value": 1.0}'
+    assert spawned == [bench._PROBE_FLAG, bench._CHILD_FLAG]
 
 
-def test_overstaying_child_is_abandoned_not_killed(monkeypatch):
-    """A child that never exits must not be signalled; after the grace
-    deadline the parent falls back to CPU."""
+def test_overstaying_probe_blocks_further_children(monkeypatch):
+    """A hung probe means a wedged tunnel; the parent must abandon it
+    (never signal it) AND must not launch a bench child behind it — the
+    tunnel admits one client at a time."""
     bench = _bench()
     monkeypatch.delenv("JAX_PLATFORMS", raising=False)
-    monkeypatch.setattr(bench, "_CHILD_ALARM_S", 0)
+    monkeypatch.setattr(bench, "_PROBE_ALARM_S", 0)
     monkeypatch.setattr(bench, "_PARENT_EXTRA_S", 1)
+    spawned = []
 
     class Hung:
         def __init__(self, *a, **k):
-            pass
+            spawned.append(_flag_of(a))
 
         def poll(self):
             return None  # never exits
@@ -77,28 +94,126 @@ def test_overstaying_child_is_abandoned_not_killed(monkeypatch):
 
     monkeypatch.setattr(bench.subprocess, "Popen", Hung)
     fell_back = []
-    monkeypatch.setattr(bench, "_cpu_fallback", lambda: fell_back.append(1))
+    monkeypatch.setattr(bench, "_cpu_fallback",
+                        lambda reason: fell_back.append(reason))
     monkeypatch.setattr(bench.time, "sleep", lambda s: None)
     bench.main()
-    assert fell_back == [1]
+    assert fell_back == ["probe_overstayed_tunnel_wedged"]
+    assert spawned == [bench._PROBE_FLAG]
 
 
-def test_failed_child_falls_back(monkeypatch):
+def test_overstaying_bench_child_is_abandoned_not_killed(monkeypatch):
+    """Probe healthy, bench child never exits: abandon (no signal), fall
+    back, and do NOT retry behind the hung client."""
     bench = _bench()
     monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    monkeypatch.setattr(bench, "_CHILD_ALARM_S", 0)
+    monkeypatch.setattr(bench, "_PROBE_ALARM_S", 0)
+    monkeypatch.setattr(bench, "_PARENT_EXTRA_S", 1)
+    spawned = []
 
-    class SelfTimedOut:
-        def __init__(self, *a, **k):
-            pass
+    class ProbeOkBenchHung:
+        def __init__(self, *a, stdout=None, **k):
+            self.flag = _flag_of(a)
+            spawned.append(self.flag)
+            if self.flag == bench._PROBE_FLAG:
+                stdout.write("# probe ok: FakeTpu\n")
+                stdout.flush()
 
         def poll(self):
-            return 3  # the child's own alarm exit
+            return 0 if self.flag == bench._PROBE_FLAG else None
 
-    monkeypatch.setattr(bench.subprocess, "Popen", SelfTimedOut)
+        def kill(self):  # pragma: no cover - the bug this test pins
+            raise AssertionError("child was signalled")
+
+        terminate = kill
+        send_signal = kill
+
+    monkeypatch.setattr(bench.subprocess, "Popen", ProbeOkBenchHung)
     fell_back = []
-    monkeypatch.setattr(bench, "_cpu_fallback", lambda: fell_back.append(1))
+    monkeypatch.setattr(bench, "_cpu_fallback",
+                        lambda reason: fell_back.append(reason))
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
     bench.main()
-    assert fell_back == [1]
+    assert fell_back == ["bench_child_overstayed_tunnel_wedged"]
+    assert spawned == [bench._PROBE_FLAG, bench._CHILD_FLAG]
+
+
+def test_failed_bench_child_is_retried_then_falls_back(monkeypatch):
+    """A self-timed-out bench child (rc 3, tunnel alive) earns a second
+    spaced attempt before the CPU fallback; the reason names the rc and
+    attempt count."""
+    bench = _bench()
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    spawned = []
+
+    class ProbeOkBenchTimesOut:
+        def __init__(self, *a, stdout=None, **k):
+            self.flag = _flag_of(a)
+            spawned.append(self.flag)
+            if self.flag == bench._PROBE_FLAG:
+                stdout.write("# probe ok: FakeTpu\n")
+                stdout.flush()
+
+        def poll(self):
+            return 0 if self.flag == bench._PROBE_FLAG else 3
+
+    monkeypatch.setattr(bench.subprocess, "Popen", ProbeOkBenchTimesOut)
+    fell_back = []
+    monkeypatch.setattr(bench, "_cpu_fallback",
+                        lambda reason: fell_back.append(reason))
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    bench.main()
+    assert fell_back == ["bench_child_rc3_after_2_attempts"]
+    assert spawned == [bench._PROBE_FLAG,
+                       bench._CHILD_FLAG, bench._CHILD_FLAG]
+
+
+def test_failed_probe_is_retried_then_falls_back(monkeypatch):
+    """A probe that self-times-out (rc 3) is retried once; persistent
+    failure skips the expensive bench children entirely."""
+    bench = _bench()
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    spawned = []
+
+    class ProbeTimesOut:
+        def __init__(self, *a, stdout=None, **k):
+            spawned.append(_flag_of(a))
+
+        def poll(self):
+            return 3
+
+    monkeypatch.setattr(bench.subprocess, "Popen", ProbeTimesOut)
+    fell_back = []
+    monkeypatch.setattr(bench, "_cpu_fallback",
+                        lambda reason: fell_back.append(reason))
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    bench.main()
+    assert fell_back == ["probe_failed_rc3_after_2_attempts"]
+    assert spawned == [bench._PROBE_FLAG, bench._PROBE_FLAG]
+
+
+def test_cpu_device_probe_skips_bench_children(monkeypatch):
+    """Probe rc 4 (device resolved to cpu) is not retried — the platform
+    will not change between attempts."""
+    bench = _bench()
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    spawned = []
+
+    class ProbeCpu:
+        def __init__(self, *a, stdout=None, **k):
+            spawned.append(_flag_of(a))
+
+        def poll(self):
+            return 4
+
+    monkeypatch.setattr(bench.subprocess, "Popen", ProbeCpu)
+    fell_back = []
+    monkeypatch.setattr(bench, "_cpu_fallback",
+                        lambda reason: fell_back.append(reason))
+    bench.main()
+    assert fell_back == ["device_resolved_cpu"]
+    assert spawned == [bench._PROBE_FLAG]
 
 
 def test_bench_backends_tiny_emits_all_tiers(capsys):
