@@ -303,20 +303,26 @@ def main() -> None:
         body = raw + ("\n# --- stderr ---\n" + raw_err if raw_err else "")
         header = (f"# attempt {attempt}, child rc={rc} "
                   f"(None = overstayed/abandoned)")
-        if raw.startswith("# device:"):
-            _save_evidence("tpu_bench_child_raw.txt", header, body, saved)
-        elif rc != 0 and (raw or raw_err):
-            _save_evidence("tpu_bench_fail_raw.txt", header, body, saved)
+        json_line = None
         if rc == 0:
             lines = [l for l in raw.splitlines() if l.startswith("{")]
-            if lines:
-                print(lines[-1], flush=True)
-                return
+            json_line = lines[-1] if lines else None
+        if raw.startswith("# device:"):
+            _save_evidence("tpu_bench_child_raw.txt", header, body, saved)
+        elif json_line is None and (raw or raw_err):
+            # failed OR rc-0-without-a-result: either way this output is
+            # the only diagnosis — keep it (separate file so stubs can't
+            # overwrite real device evidence)
+            _save_evidence("tpu_bench_fail_raw.txt", header, body, saved)
+        if json_line is not None:
+            print(json_line, flush=True)
+            return
         if rc is None:
             _cpu_fallback("bench_child_overstayed_tunnel_wedged")
             return
+    tail = "rc0_no_json" if last_rc == 0 else f"rc{last_rc}"
     _cpu_fallback(
-        f"bench_child_rc{last_rc}_after_{_MAX_BENCH_ATTEMPTS}_attempts"
+        f"bench_child_{tail}_after_{_MAX_BENCH_ATTEMPTS}_attempts"
     )
 
 

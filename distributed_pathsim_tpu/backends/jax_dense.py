@@ -60,6 +60,13 @@ def _m_from_half(c):
 
 
 @jax.jit
+def _diag_from_half(c):
+    """diag(M)[i] = Σ_v C[i,v]² — the textbook-PathSim denominator,
+    without materializing M."""
+    return jnp.sum(c * c, axis=1)
+
+
+@jax.jit
 def _rowsums_asym(blocks):
     """Row sums of an arbitrary chain by folding the ones-vector from the
     right — never materializes anything wider than a block."""
@@ -165,17 +172,31 @@ class JaxDenseBackend(PathSimBackend):
 
     # -- on-device scoring fast paths -------------------------------------
 
+    def _denominator_device(self, c, rowsums, variant: str):
+        """The fused kernels take an arbitrary denominator vector —
+        "rowsum" passes the global-walk row sums (reference semantics),
+        "diagonal" passes diag(M)[i] = Σ_v C[i,v]² (textbook PathSim,
+        Sun et al.; SURVEY.md §3.3) computed without materializing M.
+        diag(M) ≤ rowsums(M) elementwise (colsum_v ≥ C[i,v]), so the
+        f32 exact-count guard on the row sums covers both."""
+        if variant == "rowsum":
+            return rowsums
+        if variant == "diagonal":
+            return _diag_from_half(c)
+        raise ValueError(f"unknown PathSim variant {variant!r}")
+
     def all_pairs_scores(self, variant: str = "rowsum") -> np.ndarray:
-        if not self._symmetric or variant != "rowsum":
+        if not self._symmetric:
             return super().all_pairs_scores(variant)
         c, rowsums = self._half()
+        d = self._denominator_device(c, rowsums, variant)
         if self.use_pallas:
             if pk.fits_vmem(c.shape[1]):
-                scores = pk.fused_scores(c, rowsums)
+                scores = pk.fused_scores(c, d)
             else:
-                scores = pk.fused_scores_ktiled(c, rowsums)
+                scores = pk.fused_scores_ktiled(c, d)
         else:
-            scores = pk.fused_scores_reference(c, rowsums)
+            scores = pk.fused_scores_reference(c, d)
         # Fetch + exactness check AFTER the kernel dispatch (async, so
         # the transfer rides along) — and only once per backend: the
         # rowsums are as immutable as the graph.
@@ -184,24 +205,41 @@ class JaxDenseBackend(PathSimBackend):
             self._check_exact(self._rowsums)
         return np.asarray(scores)
 
-    def topk(self, k: int = 10, mask_self: bool = True):
-        """Per-source top-k (values, indices), fully on device."""
+    def topk(self, k: int = 10, mask_self: bool = True,
+             variant: str = "rowsum"):
+        """Per-source top-k (values, indices), fully on device. Both
+        score variants ride the same fused kernels — only the
+        denominator vector differs (_denominator_device)."""
         if not self._symmetric:
             raise ValueError("topk fast path requires a symmetric metapath")
         c, rowsums = self._half()
+        d = self._denominator_device(c, rowsums, variant)
         if self.use_pallas and k <= pk._CAND and pk.twopass_fits(c.shape[0]):
             # Fastest path: candidate extraction + XLA reduce (handles
             # any V internally). Beyond the candidate-buffer HBM budget
-            # (~92k rows — twopass_fits) the fold kernel takes over.
+            # (~92k rows — twopass_fits) the rect row-tile streaming
+            # path takes over at the same kernel speed.
             vals, idxs = pk.fused_topk_twopass(
-                c, rowsums, k=k, mask_self=mask_self
+                c, d, k=k, mask_self=mask_self
             )
+        elif (
+            self.use_pallas
+            and mask_self                      # rect always self-excludes
+            and self.dtype == jnp.float32
+            and pk.rect_supported(c.shape[1], k)
+        ):
+            # Square two-pass outgrew its candidate buffer (~92k rows):
+            # stream row tiles through the rectangular two-pass kernel
+            # instead of falling off the cliff onto the single-pass fold
+            # (measured 8× slower at 32k — KERNELS_r03.json). Same
+            # kernel family the sparse streaming tier uses at 1M rows.
+            vals, idxs = self._topk_rect_stream(c, d, k)
         elif self.use_pallas and not pk.fits_vmem(c.shape[1]):
-            vals, idxs = pk.fused_topk_ktiled(c, rowsums, k=k, mask_self=mask_self)
+            vals, idxs = pk.fused_topk_ktiled(c, d, k=k, mask_self=mask_self)
         elif self.use_pallas:
-            vals, idxs = pk.fused_topk(c, rowsums, k=k, mask_self=mask_self)
+            vals, idxs = pk.fused_topk(c, d, k=k, mask_self=mask_self)
         else:
-            scores = pk.fused_scores_reference(c, rowsums)
+            scores = pk.fused_scores_reference(c, d)
             if mask_self:
                 n = scores.shape[0]
                 scores = jnp.where(jnp.eye(n, dtype=bool), -jnp.inf, scores)
@@ -213,3 +251,41 @@ class JaxDenseBackend(PathSimBackend):
         # np.asarray fetches are two ~70 ms round-trips.
         vals_h, idxs_h = jax.device_get((vals, idxs))
         return np.asarray(vals_h), np.asarray(idxs_h)
+
+    # Row-tile width for the rect streaming path (halved until the
+    # packed candidate buffer fits its HBM budget at large N).
+    _RECT_TILE_ROWS = 8192
+
+    def _topk_rect_stream(self, c, d, k: int):
+        """Per-source top-k beyond the square two-pass candidate-buffer
+        budget: pad (C, denominators) to kernel shape once, then score
+        each row tile against the full column range with the rectangular
+        two-pass kernel. Results stay on device ([N, k] is tiny); the
+        caller does the single batched fetch."""
+        n = c.shape[0]
+        tile_rows = self._RECT_TILE_ROWS
+        while tile_rows > 256 and not pk.rect_fits(n, tile_rows):
+            tile_rows //= 2
+        cc, dc = pk.rect_pad_factor(c, d)
+        # Extend the stripe-aligned pad to a whole number of row tiles
+        # so every dynamic_slice below is full-size (a clamped slice
+        # would silently re-rank earlier rows).
+        full = -(-cc.shape[0] // tile_rows) * tile_rows
+        if full > cc.shape[0]:
+            cc = jnp.pad(cc, ((0, full - cc.shape[0]), (0, 0)))
+            dc = jnp.pad(dc, (0, full - dc.shape[0]))
+        interp = not pk.pallas_supported()
+        outs = []
+        for i0 in range(0, n, tile_rows):
+            ci = jax.lax.dynamic_slice(cc, (i0, 0), (tile_rows, cc.shape[1]))
+            di = jax.lax.dynamic_slice(dc, (i0,), (tile_rows,))
+            row_ids = i0 + jnp.arange(tile_rows, dtype=jnp.int32)
+            outs.append(
+                pk.fused_topk_twopass_rect(
+                    ci, cc, di, dc, row_ids,
+                    k=k, n_true_cols=n, interpret=interp,
+                )
+            )
+        vals = jnp.concatenate([v for v, _ in outs])[:n]
+        idxs = jnp.concatenate([i for _, i in outs])[:n]
+        return vals, idxs

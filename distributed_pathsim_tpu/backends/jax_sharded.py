@@ -155,7 +155,8 @@ class JaxShardedBackend(PathSimBackend):
     def pairwise_row(self, source_index: int) -> np.ndarray:
         return self.commuting_matrix()[source_index]
 
-    def topk(self, k: int = 10, mask_self: bool = True):
+    def topk(self, k: int = 10, mask_self: bool = True,
+             variant: str = "rowsum"):
         """Distributed per-row top-k via the ppermute ring: no device
         ever holds more than an [n_loc, n_loc] score tile, and only
         [N, k] winners come back to the host."""
@@ -166,6 +167,7 @@ class JaxShardedBackend(PathSimBackend):
             k=k,
             n_true=self.n,
             mask_self=mask_self,
+            variant=variant,
         )
         return (
             _fetch(vals).astype(np.float64)[: self.n],

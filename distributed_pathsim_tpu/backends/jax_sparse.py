@@ -67,6 +67,7 @@ class JaxSparseBackend(PathSimBackend):
         self._rect_kernel = rect_kernel
         self._rect_factor = None
         self._rowsums: np.ndarray | None = None
+        self._diag: np.ndarray | None = None
         self._m: np.ndarray | None = None
 
     def _use_rect_kernel(self, k: int) -> bool:
@@ -124,9 +125,11 @@ class JaxSparseBackend(PathSimBackend):
             out[j * t.tile_rows : (j + 1) * t.tile_rows] = tile[0]
         return out[: self.n]
 
-    def _run_config(self, k: int, symmetric: bool = True) -> dict:
-        """Checkpoint identity: graph fingerprint + tiling + k. A reused
-        directory from a different run must fail, not resume."""
+    def _run_config(self, k: int, symmetric: bool = True,
+                    variant: str = "rowsum") -> dict:
+        """Checkpoint identity: graph fingerprint + tiling + k + score
+        variant + compute path. A reused directory from a different run
+        must fail, not resume."""
         import hashlib
 
         c = self._c
@@ -135,6 +138,7 @@ class JaxSparseBackend(PathSimBackend):
         h.update(np.ascontiguousarray(c.cols, dtype=np.int64).tobytes())
         h.update(np.ascontiguousarray(c.weights, dtype=np.float64).tobytes())
         digest = h.hexdigest()[:16]
+        scanned = self.tiled.dense_bytes() <= self._dense_c_budget
         return {
             "n": int(self.n),
             "v": int(c.shape[1]),
@@ -145,6 +149,18 @@ class JaxSparseBackend(PathSimBackend):
             "metapath": self.metapath.name,
             "dtype": str(np.dtype(self.tiled.dtype)),
             "exact_counts": bool(self.exact_counts),
+            "variant": variant,
+            # The active compute path is checkpoint identity too: the
+            # rect kernel's f32 rounding and tie-break indices can
+            # differ from the fold paths', so a run started on one path
+            # (e.g. CPU fold) must not silently resume on another
+            # (TPU rect) and mix numerics across row tiles.
+            "compute_path": (
+                "sym" if symmetric
+                else "rect" if scanned and self._use_rect_kernel(k)
+                else "scan-fold" if scanned
+                else "tile-fold"
+            ),
             # Bump whenever the numeric regime OR resume protocol of
             # saved units changes — v2 = full sweep, per-row-tile units
             # skipped independently on resume; v3-sym = symmetric
@@ -180,28 +196,30 @@ class JaxSparseBackend(PathSimBackend):
         bests of not-yet-finished row tiles) so a killed half-sweep
         restarts at its last completed outer tile, not from scratch.
         """
-        if variant != "rowsum":
-            raise ValueError("streaming top-k supports the rowsum variant")
         ckpt = None
         if checkpoint_dir is not None:
             from ..utils.checkpoint import CheckpointManager
 
             ckpt = CheckpointManager(
                 checkpoint_dir,
-                config=self._run_config(k, symmetric),
+                config=self._run_config(k, symmetric, variant),
                 # Directories written before these identity keys existed
                 # used exactly these values — keep them resumable.
-                config_defaults={"dtype": "float32", "exact_counts": True},
+                # (compute_path has NO default on purpose: the path an
+                # old directory used cannot be known, so it must fail
+                # loudly rather than risk mixed numerics.)
+                config_defaults={"dtype": "float32", "exact_counts": True,
+                                 "variant": "rowsum"},
             )
         if symmetric:
-            return self._topk_scores_symmetric(k, ckpt)
+            return self._topk_scores_symmetric(k, ckpt, variant)
         t = self.tiled
         # Row sums live on device for the whole pass; the merge loop below
         # never brings a score tile to the host (sp.stream_merge_topk) —
         # only the [tile, k] winners per completed row tile come back.
-        # Lazily built (_rowsums_device_padded): a run resuming entirely
+        # Lazily built (_denoms_device_padded): a run resuming entirely
         # from checkpoint never touches the graph at all.
-        rowsums_device = self._rowsums_device_padded()
+        rowsums_device = self._denoms_device_padded(variant)
         vals, idxs = self._empty_result(k)
         scanned = t.dense_bytes() <= self._dense_c_budget
 
@@ -244,11 +262,22 @@ class JaxSparseBackend(PathSimBackend):
                 # v5e (740 s → 162 s rank-all; SCALE_r03_TPU.json).
                 # The factor is padded to kernel shape once (cached):
                 # the kernel skips its own O(N·128) pad on every call.
-                if self._rect_factor is None:
-                    self._rect_factor = pk.rect_pad_factor(
-                        t.dense_device(), d_all
+                # The cache is VARIANT-KEYED: dc is the denominator
+                # vector, and reusing a rowsum-padded dc for a diagonal
+                # pass would silently score the wrong variant.
+                if (
+                    self._rect_factor is None
+                    or self._rect_factor[0] != variant
+                ):
+                    self._rect_factor = (
+                        variant,
+                        *pk.rect_pad_factor(t.dense_device(), d_all),
                     )
-                cc, dc = self._rect_factor
+                    # the rect path only ever slices the padded copy —
+                    # holding the unpadded dense C too would double the
+                    # factor's HBM residency for the whole pass
+                    t.drop_dense()
+                _, cc, dc = self._rect_factor
                 ci = jax.lax.dynamic_slice(
                     cc, (i0, 0), (t.tile_rows, cc.shape[1])
                 )
@@ -296,21 +325,40 @@ class JaxSparseBackend(PathSimBackend):
     # and a device sync per iteration for resilience nobody needs.
     _PARTIALS_EVERY = 8
 
-    def _rowsums_device_padded(self):
-        """Lazy padded row sums on device, shared by both sweeps: a run
-        resuming entirely from checkpoint must never touch the graph."""
+    def diag_walks(self) -> np.ndarray:
+        """diag(M)[i] = Σ_v C[i,v]² — the textbook-PathSim denominator
+        (SURVEY.md §3.3), straight from the summed COO (O(nnz), no dense
+        C, no M). diag ≤ M's row sums elementwise, so the f32 guard on
+        the row sums covers it."""
+        if self._diag is None:
+            s = self._c.summed()
+            self._diag = np.bincount(
+                s.rows, weights=s.weights**2, minlength=self.n
+            ).astype(np.float64)
+        return self._diag
+
+    def _denoms_device_padded(self, variant: str = "rowsum"):
+        """Lazy padded denominator vector on device, shared by both
+        sweeps: a run resuming entirely from checkpoint must never touch
+        the graph. The streaming kernels take an arbitrary denominator —
+        the variant only changes which vector rides along."""
+        if variant not in ("rowsum", "diagonal"):
+            raise ValueError(f"unknown PathSim variant {variant!r}")
         t = self.tiled
         d_dev = None
 
-        def rowsums_device():
+        def denoms_device():
             nonlocal d_dev
             if d_dev is None:
                 d_pad = np.zeros(t.n_tiles * t.tile_rows)
-                d_pad[: self.n] = self.global_walks()
+                d_pad[: self.n] = (
+                    self.global_walks() if variant == "rowsum"
+                    else self.diag_walks()
+                )
                 d_dev = jnp.asarray(d_pad, dtype=t.dtype)
             return d_dev
 
-        return rowsums_device
+        return denoms_device
 
     def _empty_result(self, k: int):
         return (
@@ -318,7 +366,7 @@ class JaxSparseBackend(PathSimBackend):
             np.zeros((self.n, k), dtype=np.int64),
         )
 
-    def _topk_scores_symmetric(self, k: int, ckpt):
+    def _topk_scores_symmetric(self, k: int, ckpt, variant: str = "rowsum"):
         """Symmetric half-sweep: outer tile i, inner j ∈ [i, n_tiles);
         each off-diagonal tile folds into row blocks i AND j
         (sp.stream_merge_topk_pair). Row block r is complete when outer
@@ -339,7 +387,7 @@ class JaxSparseBackend(PathSimBackend):
         import jax
 
         t = self.tiled
-        rowsums_device = self._rowsums_device_padded()
+        rowsums_device = self._denoms_device_padded(variant)
         vals, idxs = self._empty_result(k)
         empty_v = jnp.full((t.tile_rows, k), -jnp.inf, dtype=t.dtype)
         empty_i = jnp.zeros((t.tile_rows, k), dtype=jnp.int32)

@@ -147,22 +147,17 @@ class PathSimDriver:
         pallas on TPU), dense score matrix + argsort (any backend).
         """
         b = self.backend
-        if hasattr(b, "topk_scores") and self.variant == "rowsum":
+        if hasattr(b, "topk_scores"):
             vals, idxs = b.topk_scores(
                 k=k, variant=self.variant, checkpoint_dir=checkpoint_dir
             )
             return np.asarray(vals, dtype=np.float64), np.asarray(idxs)
         if checkpoint_dir is not None:
             raise ValueError(
-                "checkpointed ranking requires the jax-sparse backend "
-                "and the rowsum variant"
+                "checkpointed ranking requires the jax-sparse backend"
             )
-        if (
-            self.variant == "rowsum"
-            and hasattr(b, "topk")
-            and b.metapath.is_symmetric
-        ):
-            vals, idxs = b.topk(k=k, mask_self=True)
+        if hasattr(b, "topk") and b.metapath.is_symmetric:
+            vals, idxs = b.topk(k=k, mask_self=True, variant=self.variant)
             return (
                 np.asarray(vals, dtype=np.float64),
                 np.asarray(idxs, dtype=np.int64),

@@ -126,7 +126,8 @@ def sharded_chain_outputs(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("mesh", "axis", "k", "n_true", "mask_self")
+    jax.jit,
+    static_argnames=("mesh", "axis", "k", "n_true", "mask_self", "variant"),
 )
 def sharded_topk(
     first: jax.Array,
@@ -136,12 +137,17 @@ def sharded_topk(
     n_true: int,
     axis: str = "dp",
     mask_self: bool = True,
+    variant: str = "rowsum",
 ):
     """Distributed per-row top-k without materializing any score block
     bigger than [n_loc, n_loc]: local half-chain fold, one ``psum`` for
     column totals, then the ``ppermute`` ring streams peer C-blocks and
     folds score tiles into each device's running top-k
-    (ring.ring_topk_rowblock). Output is row-sharded [N_pad, k]."""
+    (ring.ring_topk_rowblock). Output is row-sharded [N_pad, k].
+
+    ``variant`` picks the denominator the ring carries: "rowsum" needs
+    the one psum above; "diagonal" (diag(M)[i] = Σ_v C[i,v]², textbook
+    PathSim) is purely local — no collective at all."""
 
     @functools.partial(
         jax.shard_map,
@@ -154,8 +160,13 @@ def sharded_topk(
             c_local = first_local
             for b in rest_blocks:
                 c_local = jnp.matmul(c_local, b)
-            colsum_total = jax.lax.psum(jnp.sum(c_local, axis=0), axis)
-            d_local = jnp.matmul(c_local, colsum_total)
+            if variant == "rowsum":
+                colsum_total = jax.lax.psum(jnp.sum(c_local, axis=0), axis)
+                d_local = jnp.matmul(c_local, colsum_total)
+            elif variant == "diagonal":
+                d_local = jnp.sum(c_local * c_local, axis=1)
+            else:
+                raise ValueError(f"unknown PathSim variant {variant!r}")
         return ring_topk_rowblock(
             c_local, d_local, axis, k=k, n_true=n_true, mask_self=mask_self
         )

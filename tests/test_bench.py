@@ -239,3 +239,31 @@ def test_bench_backends_tiny_emits_all_tiers(capsys):
         assert rec["vs_baseline"] is None  # CPU mesh: no TPU ratio
         names.add(rec["metric"].split("author_pairs_per_sec_")[1].split("_")[0])
     assert names == {"jax", "jax-sharded", "jax-sparse"}
+
+
+def test_rc0_child_without_json_gets_distinct_reason(monkeypatch):
+    """A child that exits 0 but prints no JSON line must burn its
+    attempts like a failure and name the real problem, not 'rc0'."""
+    bench = _bench()
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    spawned = []
+
+    class ProbeOkBenchSilent:
+        def __init__(self, *a, stdout=None, **k):
+            self.flag = _flag_of(a)
+            spawned.append(self.flag)
+            if self.flag == bench._PROBE_FLAG:
+                stdout.write("# probe ok: FakeTpu\n")
+                stdout.flush()
+            # bench child: rc 0, no output at all
+
+        def poll(self):
+            return 0
+
+    monkeypatch.setattr(bench.subprocess, "Popen", ProbeOkBenchSilent)
+    fell_back = []
+    monkeypatch.setattr(bench, "_cpu_fallback",
+                        lambda reason: fell_back.append(reason))
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    bench.main()
+    assert fell_back == ["bench_child_rc0_no_json_after_2_attempts"]

@@ -136,3 +136,73 @@ def test_save_load_resume_training(hin, tmp_path):
     la = a.train(steps=5, batch_size=256, seed=42)
     lb = b.train(steps=5, batch_size=256, seed=42)
     np.testing.assert_allclose(la, lb, rtol=1e-5)
+
+
+def test_struct_index_approximates_scores(hin):
+    """φ(i)·φ(j) must reproduce exact scores within the quadrature's
+    uniform RELATIVE error bound — no training involved."""
+    model = NeuralPathSim(hin, "APVPA", dim=8, hidden=16, seed=0)
+    exact = model.exact_scores()
+    phi = model.struct_embeddings()
+    approx = (phi @ phi.T).astype(np.float64)
+    ii, jj = np.nonzero(exact > 0)
+    rel = np.abs(approx[ii, jj] - exact[ii, jj]) / exact[ii, jj]
+    assert rel.max() < 0.1, rel.max()  # m=12 measured ~7% worst-case
+
+
+def test_struct_rerank_recall_is_near_perfect(hin):
+    """The analytic index + exact rerank: recall@k vs the exact ranking
+    (the learned tower plays no part)."""
+    model = NeuralPathSim(hin, "APVPA", dim=8, hidden=16, seed=0)
+    exact = model.exact_scores()
+    masked = exact.copy()
+    np.fill_diagonal(masked, -np.inf)
+    recalls = []
+    for i in range(0, 200, 7):
+        npos = int((masked[i] > 0).sum())
+        if npos == 0:
+            continue
+        k = min(10, npos)
+        kth = np.sort(masked[i])[::-1][k - 1]
+        got = {t for t, _ in model.topk_rerank(i, k=k, candidates=50,
+                                               index="struct")}
+        recalls.append(sum(masked[i][t] >= kth for t in got) / k)
+    assert np.mean(recalls) >= 0.99, np.mean(recalls)
+
+
+def test_struct_index_untouched_by_training(hin):
+    model = NeuralPathSim(hin, "APVPA", dim=8, hidden=16, seed=0)
+    before = model.topk_struct(3, k=5)
+    model.train(steps=2, batch_size=64, seed=0)
+    assert model.topk_struct(3, k=5) == before
+
+
+def test_save_load_preserves_scale_and_quadrature(hin, tmp_path):
+    """target_scale and the quadrature are restored verbatim, not
+    recomputed from the f32-cast stored C (ADVICE r03)."""
+    model = NeuralPathSim(hin, "APVPA", dim=16, hidden=32, seed=0)
+    model.train(steps=5, batch_size=256, seed=0)
+    p = str(tmp_path / "m.npz")
+    model.save(p)
+    loaded = NeuralPathSim.load(p)
+    assert loaded.target_scale == model.target_scale
+    np.testing.assert_array_equal(loaded._quad_t, model._quad_t)
+    np.testing.assert_array_equal(loaded._quad_w, model._quad_w)
+    assert loaded.topk_struct(3, k=5) == model.topk_struct(3, k=5)
+
+
+def test_rerank_rejects_unknown_index(hin):
+    model = NeuralPathSim(hin, "APVPA", dim=8, hidden=16, seed=0)
+    with pytest.raises(ValueError, match="unknown index"):
+        model.topk_rerank(0, index="bogus")
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
+def test_sharded_small_batch_rounds_up(hin):
+    """batch_size below SLATE·n_devices must train (source axis rounds
+    up to a device multiple), not crash the dp-sharding divisibility."""
+    model = NeuralPathSim(
+        hin, "APVPA", dim=8, hidden=16, seed=3, mesh=make_mesh(8)
+    )
+    losses = model.train(steps=2, batch_size=64, seed=1)
+    assert len(losses) == 2 and all(np.isfinite(losses))
