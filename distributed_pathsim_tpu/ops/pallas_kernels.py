@@ -690,6 +690,11 @@ def fused_topk_twopass_rect(
     indices (self-exclusion: any candidate whose column equals its
     row's global id is dropped on the candidate list — exact because
     each tile keeps _CAND > k candidates). Requires rect_supported(V, k).
+
+    Callable inside a ``shard_map`` ONLY with ``check_vma=False`` (the
+    ring fold does this): jax's pallas loop discharge does not
+    propagate varying-axis metadata, and annotating the out_shapes
+    does not rescue the checked mode — verified empirically.
     """
     t, v = c_rows.shape
     n, _ = c_cols.shape

@@ -77,6 +77,7 @@ def ring_topk_rowblock(
     k: int,
     n_true: int,
     mask_self: bool = True,
+    use_pallas: bool | None = None,
 ):
     """Inside shard_map: per-row top-k PathSim scores for this device's
     row-block, streaming peer blocks around the ``axis`` ring.
@@ -89,10 +90,25 @@ def ring_topk_rowblock(
     all of C ever exist anywhere, which is what the million-author
     regime needs.
 
+    ``use_pallas``: each ring step's score-and-extract runs through the
+    rectangular two-pass Pallas kernel (MXU tile products + packed
+    candidate extraction — the same kernel the single-chip tiers use,
+    so a real slice keeps the single-chip kernel wins instead of
+    falling back to a plain-jnp fold). Auto: on a real TPU whenever the
+    kernel supports (V, k); pass True to force it in interpret mode
+    (virtual-mesh tests). Both paths share tie-break semantics
+    (lowest global column), so results are identical.
+
     c_local: [n_loc, V] — this device's rows of C.
     d_local: [n_loc] — this device's rows of the global rowsum vector.
     Returns (values [n_loc, k], indices [n_loc, k]) for this row-block.
     """
+    from ..ops import pallas_kernels as pk
+
+    if use_pallas is None:
+        use_pallas = pk.pallas_supported() and pk.rect_supported(
+            c_local.shape[1], k
+        )
     n_dev = jax.lax.axis_size(axis)
     my = jax.lax.axis_index(axis)
     n_loc = c_local.shape[0]
@@ -104,24 +120,49 @@ def ring_topk_rowblock(
     def step(t, carry):
         block, d_block, best_v, best_i = carry
         owner = (my - t) % n_dev
-        with jax.default_matmul_precision("highest"):
-            m = jnp.matmul(c_local, block.T)
-        denom = d_local[:, None] + d_block[None, :]
-        s = jnp.where(
-            denom > 0, 2.0 * m / jnp.where(denom > 0, denom, 1.0), 0.0
-        )
-        cols = (owner * n_loc).astype(jnp.int32) + jax.lax.broadcasted_iota(
-            jnp.int32, (n_loc, n_loc), 1
-        )
-        s = jnp.where(cols >= n_true, -jnp.inf, s)  # padding columns
-        if mask_self:
-            s = jnp.where(rows == cols, -jnp.inf, s)
-        # Hierarchical prefilter narrows this step's tile to k candidates
-        # (ascending-column tie-breaks, same as the final sort) BEFORE
-        # the lexicographic merge — sorting the raw [n_loc, n_loc+k]
-        # concat each step costs O(n_loc log n_loc) per row and was the
-        # fold's dominant term at n_loc ≥ 4k (measured 4.3×).
-        tile_v, tile_i = chunked_row_topk(s, cols, k)
+        if use_pallas:
+            # Self-pairs exist only while a device holds its OWN block
+            # (owner == my); the kernel drops candidates whose column
+            # equals their row id, and -1 never matches.
+            if mask_self:
+                row_ids = jnp.where(
+                    owner == my,
+                    jnp.arange(n_loc, dtype=jnp.int32),
+                    jnp.full((n_loc,), -1, dtype=jnp.int32),
+                )
+            else:
+                row_ids = jnp.full((n_loc,), -1, dtype=jnp.int32)
+            # n_true_cols=n_loc masks only the kernel's own lane/stripe
+            # padding; RING padding (global col ≥ n_true, all in the
+            # last owner's block) is masked after the global offset.
+            tile_v, tile_loc = pk.fused_topk_twopass_rect(
+                c_local, block, d_local, d_block, row_ids,
+                k=k, n_true_cols=n_loc,
+                interpret=not pk.pallas_supported(),
+            )
+            tile_i = (owner * n_loc).astype(jnp.int32) + tile_loc
+            tile_v = jnp.where(tile_i >= n_true, -jnp.inf, tile_v)
+        else:
+            with jax.default_matmul_precision("highest"):
+                m = jnp.matmul(c_local, block.T)
+            denom = d_local[:, None] + d_block[None, :]
+            s = jnp.where(
+                denom > 0, 2.0 * m / jnp.where(denom > 0, denom, 1.0), 0.0
+            )
+            cols = (
+                (owner * n_loc).astype(jnp.int32)
+                + jax.lax.broadcasted_iota(jnp.int32, (n_loc, n_loc), 1)
+            )
+            s = jnp.where(cols >= n_true, -jnp.inf, s)  # padding columns
+            if mask_self:
+                s = jnp.where(rows == cols, -jnp.inf, s)
+            # Hierarchical prefilter narrows this step's tile to k
+            # candidates (ascending-column tie-breaks, same as the
+            # final sort) BEFORE the lexicographic merge — sorting the
+            # raw [n_loc, n_loc+k] concat each step costs
+            # O(n_loc log n_loc) per row and was the fold's dominant
+            # term at n_loc ≥ 4k (measured 4.3×).
+            tile_v, tile_i = chunked_row_topk(s, cols, k)
         merged_v = jnp.concatenate([best_v, tile_v], axis=1)
         merged_i = jnp.concatenate([best_i, tile_i], axis=1)
         best_v, best_i = _merge_topk_by_col(merged_v, merged_i, k)

@@ -127,7 +127,9 @@ def sharded_chain_outputs(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("mesh", "axis", "k", "n_true", "mask_self", "variant"),
+    static_argnames=(
+        "mesh", "axis", "k", "n_true", "mask_self", "variant", "use_pallas"
+    ),
 )
 def sharded_topk(
     first: jax.Array,
@@ -138,6 +140,7 @@ def sharded_topk(
     axis: str = "dp",
     mask_self: bool = True,
     variant: str = "rowsum",
+    use_pallas: bool | None = None,
 ):
     """Distributed per-row top-k without materializing any score block
     bigger than [n_loc, n_loc]: local half-chain fold, one ``psum`` for
@@ -148,12 +151,25 @@ def sharded_topk(
     ``variant`` picks the denominator the ring carries: "rowsum" needs
     the one psum above; "diagonal" (diag(M)[i] = Σ_v C[i,v]², textbook
     PathSim) is purely local — no collective at all."""
+    if use_pallas is None:
+        from ..ops import pallas_kernels as pk
+
+        # feasibility must be part of the auto-gate: the rect kernel
+        # serves V ≤ 512 (after lane padding) and k < _CAND; shapes it
+        # rejects must fall back to the jnp ring fold, not crash
+        v_out = rest[-1].shape[1] if rest else first.shape[1]
+        use_pallas = pk.pallas_supported() and pk.rect_supported(v_out, k)
+    # check_vma is disabled on the Pallas ring path: the pallas_call's
+    # internal loop discharge doesn't propagate varying-axis metadata
+    # (jax raises "mismatched varying manual axes ... as a temporary
+    # workaround pass check_vma=False"). The jnp fold keeps the checker.
 
     @functools.partial(
         jax.shard_map,
         mesh=mesh,
         in_specs=(P(axis, None), tuple(P() for _ in rest)),
         out_specs=(P(axis, None), P(axis, None)),
+        check_vma=not use_pallas,
     )
     def run(first_local, rest_blocks):
         with jax.default_matmul_precision("highest"):
@@ -168,7 +184,8 @@ def sharded_topk(
             else:
                 raise ValueError(f"unknown PathSim variant {variant!r}")
         return ring_topk_rowblock(
-            c_local, d_local, axis, k=k, n_true=n_true, mask_self=mask_self
+            c_local, d_local, axis, k=k, n_true=n_true,
+            mask_self=mask_self, use_pallas=use_pallas,
         )
 
     return run(first, tuple(rest))
