@@ -31,59 +31,170 @@ struct Attr {
   std::string value;
 };
 
-// Decode the five XML built-in entities plus numeric references.
-std::string decode_entities(const std::string& s) {
-  if (s.find('&') == std::string::npos) return s;
-  std::string out;
-  out.reserve(s.size());
-  for (size_t i = 0; i < s.size();) {
-    if (s[i] != '&') {
-      out += s[i++];
-      continue;
-    }
-    size_t semi = s.find(';', i);
-    if (semi == std::string::npos || semi - i > 12) {
-      out += s[i++];
-      continue;
-    }
-    std::string ent = s.substr(i + 1, semi - i - 1);
-    if (ent == "amp") out += '&';
-    else if (ent == "lt") out += '<';
-    else if (ent == "gt") out += '>';
-    else if (ent == "quot") out += '"';
-    else if (ent == "apos") out += '\'';
-    else if (!ent.empty() && ent[0] == '#') {
-      long cp = (ent.size() > 1 && (ent[1] == 'x' || ent[1] == 'X'))
-                    ? strtol(ent.c_str() + 2, nullptr, 16)
-                    : strtol(ent.c_str() + 1, nullptr, 10);
-      // UTF-8 encode the code point.
-      if (cp < 0x80) out += static_cast<char>(cp);
-      else if (cp < 0x800) {
-        out += static_cast<char>(0xC0 | (cp >> 6));
-        out += static_cast<char>(0x80 | (cp & 0x3F));
-      } else if (cp < 0x10000) {
-        out += static_cast<char>(0xE0 | (cp >> 12));
-        out += static_cast<char>(0x80 | ((cp >> 6) & 0x3F));
-        out += static_cast<char>(0x80 | (cp & 0x3F));
-      } else {
-        out += static_cast<char>(0xF0 | (cp >> 18));
-        out += static_cast<char>(0x80 | ((cp >> 12) & 0x3F));
-        out += static_cast<char>(0x80 | ((cp >> 6) & 0x3F));
-        out += static_cast<char>(0x80 | (cp & 0x3F));
+// ---------------------------------------------------------------------------
+// Strictness (r04 differential fuzz): the native parser is the DEFAULT
+// loader, and a 400-case mutation fuzz against the Python (expat) path
+// found 86 inputs expat rejects that this tokenizer silently loaded —
+// truncations, bad entities, byte corruption. A corrupted file must
+// fail loudly, not load partially; these checks close every divergence
+// class the fuzz surfaced (tests/test_native.py::test_differential_fuzz).
+// ---------------------------------------------------------------------------
+
+// Whole-document scan: reject invalid UTF-8 (incl. overlongs and
+// surrogates) and control characters outside {\t, \n, \r} — expat
+// refuses both wherever they appear (text, attributes, comments).
+bool validate_document(const std::string& data, std::string* err) {
+  const auto* s = reinterpret_cast<const unsigned char*>(data.data());
+  size_t n = data.size();
+  for (size_t i = 0; i < n;) {
+    unsigned char c = s[i];
+    if (c < 0x80) {
+      if (c < 0x20 && c != '\t' && c != '\n' && c != '\r') {
+        *err = "invalid control character";
+        return false;
       }
-    } else {
-      out += s.substr(i, semi - i + 1);  // unknown entity: keep verbatim
-      i = semi + 1;
+      ++i;
       continue;
     }
-    i = semi + 1;
+    int len;
+    if (c >= 0xC2 && c <= 0xDF) len = 2;
+    else if (c >= 0xE0 && c <= 0xEF) len = 3;
+    else if (c >= 0xF0 && c <= 0xF4) len = 4;
+    else {  // continuation byte as lead, overlong lead, or > U+10FFFF
+      *err = "invalid UTF-8";
+      return false;
+    }
+    if (i + len > n) {
+      *err = "truncated UTF-8 sequence";
+      return false;
+    }
+    for (int k = 1; k < len; ++k) {
+      if ((s[i + k] & 0xC0) != 0x80) {
+        *err = "invalid UTF-8";
+        return false;
+      }
+    }
+    if ((c == 0xE0 && s[i + 1] < 0xA0) ||   // overlong 3-byte
+        (c == 0xED && s[i + 1] >= 0xA0) ||  // UTF-16 surrogate
+        (c == 0xF0 && s[i + 1] < 0x90) ||   // overlong 4-byte
+        (c == 0xF4 && s[i + 1] >= 0x90)) {  // > U+10FFFF
+      *err = "invalid UTF-8";
+      return false;
+    }
+    // U+FFFE / U+FFFF (EF BF BE / EF BF BF) are not XML Chars; expat
+    // rejects the literal bytes just like the numeric references.
+    if (c == 0xEF && s[i + 1] == 0xBF &&
+        (s[i + 2] == 0xBE || s[i + 2] == 0xBF)) {
+      *err = "XML-invalid character U+FFFE/U+FFFF";
+      return false;
+    }
+    i += len;
   }
-  return out;
+  return true;
+}
+
+// Decode the five XML built-in entities plus numeric references —
+// STRICT: unknown entities, bare '&', and numeric references to
+// XML-invalid code points are errors (expat parity), never passed
+// through. Entities are parsed inline (no arbitrary length cap —
+// numeric references may carry leading zeros). ``out`` may be null to
+// validate without building a string; when non-null (attribute
+// values), literal whitespace normalizes to spaces the way expat's
+// attribute-value normalization does (\r\n → one space; character
+// REFERENCES like &#10; stay literal, per the XML spec).
+bool decode_entities_strict(const char* s, size_t n, std::string* out,
+                            std::string* err) {
+  for (size_t i = 0; i < n;) {
+    char c = s[i];
+    if (c != '&') {
+      if (c == '\r' && i + 1 < n && s[i + 1] == '\n') ++i;  // CRLF → LF
+      if (out) {
+        *out += (c == '\r' || c == '\n' || c == '\t') ? ' ' : c;
+      }
+      ++i;
+      continue;
+    }
+    size_t j = i + 1;
+    if (j < n && s[j] == '#') {
+      ++j;
+      bool hex = false;
+      if (j < n && (s[j] == 'x' || s[j] == 'X')) {
+        hex = true;
+        ++j;
+      }
+      size_t d0 = j;
+      long cp = 0;
+      for (; j < n; ++j) {
+        char ch = s[j];
+        int digit;
+        if (ch >= '0' && ch <= '9') digit = ch - '0';
+        else if (hex && ch >= 'a' && ch <= 'f') digit = ch - 'a' + 10;
+        else if (hex && ch >= 'A' && ch <= 'F') digit = ch - 'A' + 10;
+        else break;
+        if (cp <= 0x10FFFF) cp = cp * (hex ? 16 : 10) + digit;
+        // saturates: once past the Unicode range further digits can't
+        // bring it back, and the range check below rejects it
+      }
+      if (j == d0 || j >= n || s[j] != ';') {
+        *err = "malformed numeric character reference";
+        return false;
+      }
+      // XML 1.0 Char production: no control chars (except \t\n\r), no
+      // surrogates, no U+FFFE/U+FFFF, nothing past U+10FFFF.
+      if (cp > 0x10FFFF ||
+          (cp < 0x20 && cp != 0x9 && cp != 0xA && cp != 0xD) ||
+          (cp >= 0xD800 && cp <= 0xDFFF) || cp == 0xFFFE || cp == 0xFFFF) {
+        *err = "numeric reference to XML-invalid character";
+        return false;
+      }
+      if (out) {
+        if (cp < 0x80) *out += static_cast<char>(cp);
+        else if (cp < 0x800) {
+          *out += static_cast<char>(0xC0 | (cp >> 6));
+          *out += static_cast<char>(0x80 | (cp & 0x3F));
+        } else if (cp < 0x10000) {
+          *out += static_cast<char>(0xE0 | (cp >> 12));
+          *out += static_cast<char>(0x80 | ((cp >> 6) & 0x3F));
+          *out += static_cast<char>(0x80 | (cp & 0x3F));
+        } else {
+          *out += static_cast<char>(0xF0 | (cp >> 18));
+          *out += static_cast<char>(0x80 | ((cp >> 12) & 0x3F));
+          *out += static_cast<char>(0x80 | ((cp >> 6) & 0x3F));
+          *out += static_cast<char>(0x80 | (cp & 0x3F));
+        }
+      }
+      i = j + 1;
+      continue;
+    }
+    size_t e0 = j;
+    while (j < n &&
+           ((s[j] >= 'a' && s[j] <= 'z') || (s[j] >= 'A' && s[j] <= 'Z') ||
+            (s[j] >= '0' && s[j] <= '9'))) {
+      ++j;
+    }
+    if (j == e0 || j >= n || s[j] != ';') {
+      *err = "bare '&' (unterminated entity reference)";
+      return false;
+    }
+    std::string ent(s + e0, j - e0);
+    if (ent == "amp") { if (out) *out += '&'; }
+    else if (ent == "lt") { if (out) *out += '<'; }
+    else if (ent == "gt") { if (out) *out += '>'; }
+    else if (ent == "quot") { if (out) *out += '"'; }
+    else if (ent == "apos") { if (out) *out += '\''; }
+    else {
+      *err = "unknown entity '&" + ent + ";'";
+      return false;
+    }
+    i = j + 1;
+  }
+  return true;
 }
 
 // A minimal tag token: name + attributes + open/close/selfclose kind.
 struct Tag {
-  std::string name;
+  std::string name;          // namespace-stripped (semantic dispatch)
+  std::string raw_name;      // as written (nesting must match exactly)
   std::vector<Attr> attrs;
   bool closing = false;      // </name>
   bool self_closing = false; // <name ... />
@@ -103,21 +214,38 @@ std::string local_name(const std::string& qname) {
 struct Parser {
   const char* p;
   const char* end;
+  const char* doc_start;
   std::string error;
+  std::vector<std::string> open_stack;  // raw names of open elements
+  bool seen_root = false;
+  bool seen_doctype = false;
 
-  explicit Parser(const char* data, size_t len) : p(data), end(data + len) {}
+  explicit Parser(const char* data, size_t len)
+      : p(data), end(data + len), doc_start(data) {}
 
-  // Advance to the next tag; returns false at EOF. Skips comments,
-  // CDATA, processing instructions, and doctype declarations.
+  // True when the document ended well-formed: no error, exactly one
+  // root element, and every element closed. Truncated files (the
+  // fuzz's biggest silent-acceptance class) fail here.
+  bool eof_ok() const {
+    return error.empty() && seen_root && open_stack.empty();
+  }
+
+  // Advance to the next tag; returns false at EOF or error (check
+  // ``error``). Skips comments, CDATA, processing instructions, and
+  // doctype declarations; validates the text spans in between
+  // (strict entities; nothing but whitespace outside the root).
   bool next_tag(Tag* tag) {
     while (p < end) {
       const char* lt = static_cast<const char*>(memchr(p, '<', end - p));
-      if (!lt) return false;
+      if (!check_text(p, lt ? lt : end)) return false;
+      if (!lt) { p = end; return false; }
       p = lt + 1;
-      if (p >= end) return false;
-      if (*p == '?') {  // <?xml ... ?>
+      if (p >= end) return fail("truncated document");
+      if (*p == '?') {  // processing instruction / XML declaration
+        const char* pi_lt = p - 1;
         const char* close = strstr_bounded("?>");
         if (!close) return fail("unterminated PI");
+        if (!check_pi(p + 1, close, pi_lt == doc_start)) return false;
         p = close + 2;
         continue;
       }
@@ -129,17 +257,50 @@ struct Parser {
           continue;
         }
         if (end - p >= 8 && strncmp(p, "![CDATA[", 8) == 0) {
+          // CDATA is character data: only legal inside the root.
+          if (open_stack.empty()) {
+            return fail(seen_root ? "junk after document element"
+                                  : "CDATA before document element");
+          }
           const char* close = strstr_bounded("]]>");
           if (!close) return fail("unterminated CDATA");
           p = close + 3;
           continue;
         }
-        const char* close = static_cast<const char*>(memchr(p, '>', end - p));
-        if (!close) return fail("unterminated declaration");
-        p = close + 1;
-        continue;
+        if (end - p >= 8 && strncmp(p, "!DOCTYPE", 8) == 0 &&
+            (end - p == 8 || is_space(p[8]))) {
+          // one DOCTYPE, in the prolog only (internal subsets with
+          // nested '>' are out of scope for GEXF)
+          if (seen_root || seen_doctype) return fail("misplaced DOCTYPE");
+          seen_doctype = true;
+          const char* close =
+              static_cast<const char*>(memchr(p, '>', end - p));
+          if (!close) return fail("unterminated declaration");
+          p = close + 1;
+          continue;
+        }
+        // Anything else after '<!' is corruption — skipping it would
+        // silently drop a damaged element (e.g. a byte flip turning
+        // '<node .../>' into '<!ode .../>').
+        return fail("malformed markup declaration");
       }
-      return parse_tag(tag);
+      if (!parse_tag(tag)) return false;
+      // Well-formedness: closing tags must match the innermost open
+      // element; a second root (or any tag after the root closed) is
+      // junk after the document element.
+      if (tag->closing) {
+        if (open_stack.empty() || open_stack.back() != tag->raw_name) {
+          return fail("mismatched closing tag");
+        }
+        open_stack.pop_back();
+      } else {
+        if (open_stack.empty() && seen_root) {
+          return fail("junk after document element");
+        }
+        seen_root = true;
+        if (!tag->self_closing) open_stack.push_back(tag->raw_name);
+      }
+      return true;
     }
     return false;
   }
@@ -158,11 +319,131 @@ struct Parser {
     return false;
   }
 
+  bool fail_str(std::string msg) {
+    error = std::move(msg);
+    p = end;
+    return false;
+  }
+
+  // Processing instruction [s, e): target name must be a valid Name,
+  // and the reserved target "xml" (any case) is only legal as THE XML
+  // DECLARATION — first bytes of the document, with the strict
+  // version/encoding/standalone pseudo-attribute grammar expat
+  // enforces. Catches duplicated or displaced declarations and
+  // corruption inside the declaration itself.
+  bool check_pi(const char* s, const char* e, bool at_doc_start) {
+    const char* q = s;
+    const char* name_start = q;
+    while (q < e && is_name_char(*q)) ++q;
+    if (q == name_start ||
+        !is_name_start(static_cast<unsigned char>(*name_start))) {
+      return fail("malformed PI target");
+    }
+    std::string target(name_start, q - name_start);
+    bool is_xml_decl =
+        target.size() == 3 && (target[0] == 'x' || target[0] == 'X') &&
+        (target[1] == 'm' || target[1] == 'M') &&
+        (target[2] == 'l' || target[2] == 'L');
+    if (!is_xml_decl) return true;  // ordinary PI: contents are free-form
+    if (!at_doc_start || target != "xml") {
+      return fail("XML declaration not at start of document");
+    }
+    // version="1.x" [encoding="..."] [standalone="yes|no"]
+    const char* names[3] = {"version", "encoding", "standalone"};
+    int next_allowed = 0;
+    while (true) {
+      const char* before = q;
+      while (q < e && is_space(*q)) ++q;
+      if (q == e) break;
+      if (before == q) return fail("malformed XML declaration");
+      const char* a0 = q;
+      while (q < e && is_name_char(*q)) ++q;
+      std::string an(a0, q - a0);
+      int which = -1;
+      for (int i = next_allowed; i < 3; ++i) {
+        if (an == names[i]) { which = i; break; }
+      }
+      if (which < 0 || (which > 0 && next_allowed == 0)) {
+        return fail("malformed XML declaration");  // wrong name/order
+      }
+      next_allowed = which + 1;
+      while (q < e && is_space(*q)) ++q;
+      if (q == e || *q != '=') return fail("malformed XML declaration");
+      ++q;
+      while (q < e && is_space(*q)) ++q;
+      if (q == e || (*q != '"' && *q != '\'')) {
+        return fail("malformed XML declaration");
+      }
+      char quote = *q++;
+      const char* v0 = q;
+      while (q < e && *q != quote) ++q;
+      if (q == e) return fail("malformed XML declaration");
+      std::string val(v0, q - v0);
+      ++q;
+      if (which == 0) {
+        if (val.size() < 3 || val.compare(0, 2, "1.") != 0) {
+          return fail("malformed XML declaration");
+        }
+        for (size_t i = 2; i < val.size(); ++i) {
+          if (val[i] < '0' || val[i] > '9') {
+            return fail("malformed XML declaration");
+          }
+        }
+      } else if (which == 1) {
+        if (val.empty()) return fail("malformed XML declaration");
+        for (char c : val) {
+          if (!((c >= 'A' && c <= 'Z') || (c >= 'a' && c <= 'z') ||
+                (c >= '0' && c <= '9') || c == '.' || c == '_' ||
+                c == '-')) {
+            return fail("malformed XML declaration");
+          }
+        }
+      } else if (val != "yes" && val != "no") {
+        return fail("malformed XML declaration");
+      }
+    }
+    if (next_allowed == 0) return fail("malformed XML declaration");
+    return true;
+  }
+
+  // Text between tags: outside the root only whitespace is allowed;
+  // inside, entity references must be valid (content itself is
+  // discarded — GEXF carries data in attributes).
+  bool check_text(const char* s, const char* e) {
+    if (open_stack.empty()) {
+      for (const char* q = s; q < e; ++q) {
+        if (!is_space(*q)) {
+          return fail(seen_root ? "junk after document element"
+                                : "text before document element");
+        }
+      }
+      return true;
+    }
+    std::string err;
+    if (!decode_entities_strict(s, e - s, nullptr, &err)) {
+      return fail_str(err + " in text");
+    }
+    return true;
+  }
+
   static bool is_space(char c) {
     return c == ' ' || c == '\t' || c == '\n' || c == '\r';
   }
-  static bool is_name_char(char c) {
-    return !is_space(c) && c != '>' && c != '/' && c != '=';
+  // XML NameChar (ASCII range; ≥0x80 allowed through as in
+  // is_name_start). Anything looser lets corrupted names like
+  // "sou&rce" parse as names expat rejects.
+  static bool is_name_char(char ch) {
+    unsigned char c = static_cast<unsigned char>(ch);
+    return (c >= 'A' && c <= 'Z') || (c >= 'a' && c <= 'z') ||
+           (c >= '0' && c <= '9') || c == '-' || c == '.' || c == '_' ||
+           c == ':' || c >= 0x80;
+  }
+  // XML NameStartChar, ASCII range (multi-byte UTF-8 leads are allowed
+  // through — the document-level scan guarantees they are valid
+  // sequences, and non-ASCII element names don't occur in GEXF).
+  static bool is_name_start(unsigned char c) {
+    return (c >= 'A' && c <= 'Z') || (c >= 'a' && c <= 'z') || c == '_' ||
+           c == ':' || c >= 0x80;
   }
 
   bool parse_tag(Tag* tag) {
@@ -174,7 +455,11 @@ struct Parser {
     }
     const char* start = p;
     while (p < end && is_name_char(*p)) ++p;
-    tag->name = local_name(std::string(start, p - start));
+    if (p == start || !is_name_start(static_cast<unsigned char>(*start))) {
+      return fail("malformed tag name");
+    }
+    tag->raw_name.assign(start, p - start);
+    tag->name = local_name(tag->raw_name);
     // attributes
     while (p < end) {
       while (p < end && is_space(*p)) ++p;
@@ -184,6 +469,7 @@ struct Parser {
         return true;
       }
       if (*p == '/') {
+        if (tag->closing) return fail("malformed closing tag");
         ++p;
         if (p < end && *p == '>') {
           ++p;
@@ -192,8 +478,13 @@ struct Parser {
         }
         return fail("stray '/' in tag");
       }
+      if (tag->closing) return fail("attribute on closing tag");
       const char* astart = p;
       while (p < end && is_name_char(*p)) ++p;
+      if (p == astart ||
+          !is_name_start(static_cast<unsigned char>(*astart))) {
+        return fail("malformed attribute name");
+      }
       std::string aname = local_name(std::string(astart, p - astart));
       while (p < end && is_space(*p)) ++p;
       if (p >= end || *p != '=') return fail("attribute without value");
@@ -205,9 +496,21 @@ struct Parser {
       const char* vend =
           static_cast<const char*>(memchr(p, quote, end - p));
       if (!vend) return fail("unterminated attribute value");
+      if (memchr(vstart, '<', vend - vstart)) {
+        return fail("'<' in attribute value");
+      }
       p = vend + 1;
-      tag->attrs.push_back(
-          {std::move(aname), decode_entities(std::string(vstart, vend - vstart))});
+      if (p < end && !is_space(*p) && *p != '>' && *p != '/') {
+        return fail("missing whitespace between attributes");
+      }
+      std::string decoded, err;
+      if (!decode_entities_strict(vstart, vend - vstart, &decoded, &err)) {
+        return fail_str(err + " in attribute value");
+      }
+      for (const auto& a : tag->attrs) {
+        if (a.name == aname) return fail("duplicate attribute");
+      }
+      tag->attrs.push_back({std::move(aname), std::move(decoded)});
     }
     return fail("unterminated tag");
   }
@@ -254,7 +557,17 @@ Gexf* gexf_parse(const char* path) {
   }
   fclose(f);
 
-  Parser parser(data.data(), data.size());
+  if (!validate_document(data, &g->error)) return g;
+
+  // A UTF-8 BOM is legal before the XML declaration — skip it so the
+  // declaration still counts as "at start of document".
+  const char* doc = data.data();
+  size_t doc_len = data.size();
+  if (doc_len >= 3 && memcmp(doc, "\xEF\xBB\xBF", 3) == 0) {
+    doc += 3;
+    doc_len -= 3;
+  }
+  Parser parser(doc, doc_len);
   Tag tag;
 
   // attribute-id → title maps, per declaration class
@@ -358,6 +671,12 @@ Gexf* gexf_parse(const char* path) {
 
   if (!parser.error.empty()) {
     g->error = parser.error;
+    return g;
+  }
+  if (!parser.eof_ok()) {
+    g->error = parser.seen_root
+                   ? "truncated document (unclosed elements at EOF)"
+                   : "no document element";
     return g;
   }
   for (const auto& e : edges) append3(&g->edges_blob, e.src, e.dst, e.rel);

@@ -297,3 +297,190 @@ def test_native_encoded_zero_edges(tmp_path):
     hin = gexf_native.read_gexf_encoded(str(p))
     assert hin.blocks == {}
     assert hin.type_size("") == 1 or len(hin.indices) == 1
+
+
+# ---- differential fuzz (r04) ----------------------------------------------
+
+
+@needs_native
+def test_differential_fuzz_python_vs_native(tmp_path):
+    """Seeded mutation fuzz: for every corrupted GEXF, the native parser
+    and the Python (expat) parser must agree — same graph when both
+    accept, or both reject. The native parser is the DEFAULT loader; a
+    laxer tokenizer would silently load partial/garbled data where the
+    Python path fails loudly (r04 hardening: the initial fuzz found 86
+    such silent acceptances in 400 mutants — truncations, bad entities,
+    byte corruption, displaced XML declarations)."""
+    import random
+
+    from distributed_pathsim_tpu.data.synthetic import synthetic_hin, write_gexf
+
+    hin = synthetic_hin(40, 70, 5, seed=9, materialize_ids=True)
+    base_p = tmp_path / "base.gexf"
+    write_gexf(hin, str(base_p))
+    base = base_p.read_bytes()
+
+    def mutate(data, rng):
+        kind = rng.choice([
+            "truncate", "byteflip", "bad_entity", "dup_line", "del_line",
+            "attr_reorder", "comment", "whitespace", "insert_bytes",
+        ])
+        if kind == "truncate":
+            return data[: rng.randrange(1, len(data))]
+        if kind == "byteflip":
+            i = rng.randrange(len(data))
+            return data[:i] + bytes([rng.randrange(256)]) + data[i + 1:]
+        if kind == "bad_entity":
+            ent = rng.choice(
+                [b"&bogus;", b"&#xZZ;", b"&#99999999;", b"&", b"&amp"]
+            )
+            i = rng.randrange(len(data))
+            return data[:i] + ent + data[i:]
+        lines = data.split(b"\n")
+        if kind == "dup_line":
+            i = rng.randrange(len(lines))
+            lines.insert(i, lines[i])
+        elif kind == "del_line":
+            del lines[rng.randrange(len(lines))]
+        elif kind == "comment":
+            lines.insert(
+                rng.randrange(len(lines)), b"<!-- fuzz <node> &amp; -->"
+            )
+        elif kind == "attr_reorder":
+            import re
+
+            return re.sub(
+                rb'<node id="([^"]*)" label="([^"]*)"',
+                rb'<node label="\2" id="\1"', data,
+            )
+        elif kind == "whitespace":
+            return data.replace(b'" ', b'"\n\t ', 1)
+        elif kind == "insert_bytes":
+            i = rng.randrange(len(data))
+            junk = bytes(
+                rng.randrange(256) for _ in range(rng.randrange(1, 8))
+            )
+            return data[:i] + junk + data[i:]
+        return b"\n".join(lines)
+
+    def outcome(fn, path):
+        try:
+            g = fn(path)
+            return (
+                "ok",
+                tuple((v.id, v.label, v.node_type) for v in g.vertices),
+                tuple((e.src, e.dst, e.relationship) for e in g.edges),
+                g.name,
+            )
+        except Exception:
+            return ("reject",)
+
+    rng = random.Random(1234)
+    mut_p = str(tmp_path / "mut.gexf")
+    n_both_ok = n_both_reject = 0
+    for trial in range(250):
+        mut = mutate(base, rng)
+        with open(mut_p, "wb") as f:
+            f.write(mut)
+        po = outcome(_read_gexf_python, mut_p)
+        no = outcome(gexf_native.read_gexf, mut_p)
+        assert po[0] == no[0], (
+            f"trial {trial}: python={po[0]} native={no[0]}\n{mut[:400]!r}"
+        )
+        if po[0] == "ok":
+            assert po == no, f"trial {trial}: content mismatch"
+            n_both_ok += 1
+        else:
+            n_both_reject += 1
+    # the fuzz must exercise both regimes to mean anything
+    assert n_both_ok > 50 and n_both_reject > 50
+
+
+@needs_native
+def test_native_rejects_malformation_classes(tmp_path):
+    """Named regressions for each hardening class (clear errors, not
+    silent partial loads)."""
+    ok_doc = (
+        "<?xml version='1.0' encoding='utf-8'?>\n"
+        '<gexf version="1.2"><graph name="g"><nodes>'
+        '<node id="a" label="A" /></nodes><edges /></graph></gexf>'
+    )
+    cases = {
+        "truncated": ok_doc[: len(ok_doc) // 2],
+        "unknown entity": ok_doc.replace('label="A"', 'label="&bogus;"'),
+        "bare ampersand": ok_doc.replace('label="A"', 'label="A &"'),
+        "numeric ref to control char": ok_doc.replace(
+            'label="A"', 'label="&#2;"'
+        ),
+        "mismatched close": ok_doc.replace("</graph>", "</grapf>"),
+        "junk after root": ok_doc + "<oops />",
+        "second xml decl": ok_doc.replace(
+            "<gexf", "<?xml version='1.0'?><gexf"
+        ),
+        "control char": ok_doc.replace('label="A"', 'label="A\x02"'),
+        "invalid utf8": ok_doc.replace('label="A"', 'label="A\udcff"'),
+        "missing attr space": ok_doc.replace(' label="A"', 'label="A"'),
+        "lt in attr value": ok_doc.replace('label="A"', 'label="<A"'),
+    }
+    for name, doc in cases.items():
+        p = tmp_path / "bad.gexf"
+        p.write_bytes(
+            doc.encode("utf-8", errors="surrogateescape")
+        )
+        try:
+            gexf_native.read_gexf(str(p))
+        except ValueError:
+            continue
+        pytest.fail(f"native parser accepted malformed case: {name}")
+
+
+@needs_native
+def test_native_expat_parity_corners(tmp_path):
+    """Named parity regressions from the r04 review: BOM acceptance,
+    attribute whitespace normalization, leading-zero numeric refs,
+    duplicate attributes, misplaced CDATA/DOCTYPE, '<!' corruption,
+    literal U+FFFF."""
+    ok_doc = (
+        "<?xml version='1.0' encoding='utf-8'?>\n"
+        '<gexf version="1.2"><graph name="g"><nodes>'
+        '<node id="a" label="A" /></nodes><edges /></graph></gexf>'
+    )
+
+    def both(doc_bytes):
+        p = tmp_path / "c.gexf"
+        p.write_bytes(doc_bytes)
+
+        def run(fn):
+            try:
+                g = fn(str(p))
+                return ("ok", [(v.id, v.label, v.node_type)
+                               for v in g.vertices])
+            except Exception:
+                return ("reject",)
+
+        return run(_read_gexf_python), run(gexf_native.read_gexf)
+
+    # BOM: both accept, identical content
+    po, no = both(b"\xef\xbb\xbf" + ok_doc.encode())
+    assert po[0] == no[0] == "ok" and po == no
+    # literal newline/tab in attribute value: both accept, normalized
+    po, no = both(ok_doc.replace('label="A"', 'label="l1\nl2\tx"').encode())
+    assert po == no and po[1][0][1] == "l1 l2 x"
+    # leading-zero numeric reference: both accept, decodes to 'A'
+    po, no = both(
+        ok_doc.replace('label="A"', 'label="&#0000000000065;"').encode()
+    )
+    assert po == no and po[1][0][1] == "A"
+    # the rest must be rejected by BOTH parsers
+    for name, doc in {
+        "duplicate attribute": ok_doc.replace(
+            'id="a" label="A"', 'id="a" id="b" label="A"'
+        ).encode(),
+        "byteflipped to <!": ok_doc.replace("<node", "<!ode").encode(),
+        "CDATA after root": (ok_doc + "<![CDATA[x]]>").encode(),
+        "literal U+FFFF": ok_doc.replace(
+            'label="A"', 'label="A"'
+        ).encode().replace(b'"g"', b'"g\xef\xbf\xbf"'),
+    }.items():
+        po, no = both(doc)
+        assert po[0] == no[0] == "reject", (name, po[0], no[0])
