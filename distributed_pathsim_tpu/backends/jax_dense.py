@@ -168,6 +168,15 @@ class JaxDenseBackend(PathSimBackend):
         return self._rowsums
 
     def pairwise_row(self, source_index: int) -> np.ndarray:
+        if self._symmetric:
+            # One GEMV against the cached half factor (the C6/C7 chain
+            # identity) — materializing M here would be O(N²) memory
+            # and crashes outright at reconstruction scale (a 227k-
+            # author single-source query is a 206 GB M).
+            c, _ = self._half()
+            with jax.default_matmul_precision("highest"):
+                row = chain.pairwise_row_from_half(c, source_index, xp=jnp)
+            return np.asarray(row, dtype=np.float64)
         return self._compute()[0][source_index]
 
     # -- on-device scoring fast paths -------------------------------------

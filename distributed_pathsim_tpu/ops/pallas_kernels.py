@@ -76,32 +76,43 @@ def _scores_kernel(c_i_ref, c_j_ref, d_i_ref, d_j_ref, out_ref):
     out_ref[:] = _normalize(_tile_dot(c_i_ref, c_j_ref), d_i_ref, d_j_ref)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def fused_scores(c: jax.Array, rowsums: jax.Array, interpret: bool = False):
+@functools.partial(
+    jax.jit, static_argnames=("interpret", "bm", "bn")
+)
+def fused_scores(c: jax.Array, rowsums: jax.Array, interpret: bool = False,
+                 bm: int | None = None, bn: int | None = None):
     """All-pairs PathSim scores from the half-chain factor, fused.
 
     c: [N, V] f32, rowsums: [N] f32 → scores [N, N] f32.
     Rows are padded to the tile size inside; padded rows have rowsum 0 and
     produce score 0 (the where-guard), then are sliced away.
+
+    ``bm``/``bn`` override the output tile (perf sweeps): arithmetic
+    intensity per HBM byte grows ∝ tile edge, so larger tiles close the
+    gap to XLA's GEMM — but every config must be validated ON CHIP
+    (scripts/kernel_bench.py --sweep-tiles; Mosaic VMEM/layout limits
+    don't reproduce in interpret mode).
     """
+    bm = _BM if bm is None else bm
+    bn = _BN if bn is None else bn
     n, v = c.shape
-    n_pad = _ceil_to(max(n, 8), _BM)
+    n_pad = _ceil_to(max(n, 8), max(bm, bn))
     v_pad = _ceil_to(max(v, 128), 128)
     c_p = jnp.zeros((n_pad, v_pad), dtype=jnp.float32).at[:n, :v].set(c)
     d_p = jnp.zeros((n_pad, 1), dtype=jnp.float32).at[:n, 0].set(rowsums)
 
-    grid = (n_pad // _BM, n_pad // _BN)
+    grid = (n_pad // bm, n_pad // bn)
     out = pl.pallas_call(
         _scores_kernel,
         out_shape=jax.ShapeDtypeStruct((n_pad, n_pad), jnp.float32),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((_BM, v_pad), lambda i, j: (i, 0)),
-            pl.BlockSpec((_BN, v_pad), lambda i, j: (j, 0)),
-            pl.BlockSpec((_BM, 1), lambda i, j: (i, 0)),
-            pl.BlockSpec((_BN, 1), lambda i, j: (j, 0)),
+            pl.BlockSpec((bm, v_pad), lambda i, j: (i, 0)),
+            pl.BlockSpec((bn, v_pad), lambda i, j: (j, 0)),
+            pl.BlockSpec((bm, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((bn, 1), lambda i, j: (j, 0)),
         ],
-        out_specs=pl.BlockSpec((_BM, _BN), lambda i, j: (i, j)),
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
         interpret=interpret,
     )(c_p, c_p, d_p, d_p)
     return out[:n, :n]

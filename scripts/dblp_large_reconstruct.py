@@ -1,0 +1,286 @@
+"""Reconstruct a dblp_large-scale GEXF from the reference's 2018 log.
+
+``dblp_large.gexf`` is stripped from the reference checkout
+(SURVEY.md §data; referenced at ``DPathSim_APVPA.py:141``), but its run
+log (``output/d_pathsim_output_20180417_020445.log``) pins 82 authors
+exactly: the source ("Jiawei Han", global walk 8,423) and 81 targets
+with their ids, labels, pairwise walks M[s,t] and global walks d_t —
+up to Ming-Syan Chen's 11,631, the largest observed row sum. This
+script builds a multi-100k-author HIN that
+
+  1. reproduces every logged constraint EXACTLY (so the product CLI's
+     single-source run from Jiawei Han prints the reference log's 81
+     sim scores digit-for-digit — spot-row validation against real
+     data, not synthetic goldens), and
+  2. fills the unconstrained mass with DBLP-shaped skew: Zipf venue
+     popularity, log-normal papers-per-author, plus the mega-venue
+     tail the constraints themselves force (Ming-Syan Chen's filler
+     venue carries ~11k incidences — the "one mega-venue row" shape
+     Zipf-synthetic benchmarks underrepresent).
+
+Skew note (vs data/synthetic.py's assumptions): the venue count is
+kept ≤ ~500 so the factor width stays inside the rect kernel's VMEM
+regime (real 2018 DBLP has a few thousand venues; the perf-relevant
+skew — the venue-degree distribution, max colsum ≈ 11.6k vs Zipf
+median ~1e2 — is preserved, the cardinality is compressed). Papers
+are single-author/single-venue: C[a,v] then counts papers directly,
+which is the only structure APVPA observes.
+
+Construction per target t (exact integer bookkeeping):
+  - pairwise walk m_t: k_t venues shared ONLY by s and t; s holds one
+    paper in each, t holds c_i with Σc_i = m_t, so M[s,t] = m_t. The
+    venue-cap c is chosen so the d_t contribution Σ c_i·(1+c_i) fits.
+  - global walk d_t: remainder r_t lands on a private filler venue
+    (one paper by t, r_t−1 crowd incidences), so
+    d_t = Σ c_i(1+c_i) + 1·(1 + (r_t−1)) exactly.
+  - the source's own d_s closes the same way after all targets.
+
+Usage: python scripts/dblp_large_reconstruct.py [--authors N]
+         [--out PATH] [--verify] [--platform cpu]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import re
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+REF_LOG = "/root/reference/output/d_pathsim_output_20180417_020445.log"
+SOURCE_LABEL = "Jiawei Han"
+SOURCE_ID = "author_jiawei_han"  # not in the log; any fresh id works
+
+
+def parse_reference_log(path: str = REF_LOG):
+    """Extract (source_walk, [(id, label, pairwise, global_walk)])."""
+    text = open(path, encoding="utf-8").read()
+    source_walk = int(
+        re.search(r"Source author global walk: (\d+)", text).group(1)
+    )
+    targets = []
+    stage = re.compile(
+        r"Pairwise authors walk (author_\d+): (\d+)\n"
+        r"Target author global walk: (\d+)\n"
+        r"Sim score Jiawei Han - (.+?): ([0-9.eE+-]+)"
+    )
+    for m in stage.finditer(text):
+        tid, pw, gw, label, score = m.groups()
+        targets.append((tid, label, int(pw), int(gw), float(score)))
+    # The log is truncated MID-STAGE: its last line pins the 82nd
+    # target's id and pairwise walk but not its global walk or label.
+    # Constrain what survives (so the reconstruction reproduces every
+    # byte the log has); the free fields get documented placeholders
+    # (label := id, global walk := 500, near the logged median).
+    tail = re.search(
+        r"Pairwise authors walk (author_\d+): (\d+)\s*\Z", text
+    )
+    if tail:
+        targets.append((tail.group(1), tail.group(1), int(tail.group(2)),
+                        500, None))
+    return source_walk, targets
+
+
+def plan_shared_venues(m_t: int, d_t: int):
+    """Split pairwise walk m_t over shared venues with per-venue cap c
+    so the global-walk contribution Σ c_i·(1+c_i) stays ≤ d_t − 1
+    (filler needs ≥ 1), minimizing the venue count."""
+    if m_t == 0:
+        return []
+    for c in range(m_t, 0, -1):
+        n_full, rest = divmod(m_t, c)
+        caps = [c] * n_full + ([rest] if rest else [])
+        if sum(ci * (1 + ci) for ci in caps) <= d_t - 1:
+            return caps
+    raise ValueError(f"cannot fit pairwise {m_t} under global {d_t}")
+
+
+def main(argv=None) -> dict:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--authors", type=int, default=200_000,
+                    help="background author count")
+    ap.add_argument("--bg-venues", type=int, default=380)
+    ap.add_argument("--mean-papers", type=float, default=2.6)
+    ap.add_argument("--out", default="/tmp/dblp_large_reconstructed.gexf")
+    ap.add_argument("--seed", type=int, default=20180417)
+    ap.add_argument("--verify", action="store_true",
+                    help="load the file back and check every constraint")
+    args = ap.parse_args(argv)
+
+    rng = np.random.default_rng(args.seed)
+    source_walk, targets = parse_reference_log()
+    t0 = time.time()
+
+    # ---- constrained core ------------------------------------------------
+    # author rows: (id, label, [(venue, papers)...])
+    core: list[tuple[str, str, list[tuple[str, int]]]] = []
+    crowd: list[tuple[str, int]] = []  # (venue, incidences) for fillers
+    src_venues: list[tuple[str, int]] = []
+    d_s_so_far = 0
+    for tid, label, m_t, d_t, _ in targets:
+        rows: list[tuple[str, int]] = []
+        used = 0
+        for i, c in enumerate(plan_shared_venues(m_t, d_t)):
+            v = f"venue_shared_{tid}_{i}"
+            rows.append((v, c))
+            src_venues.append((v, 1))
+            used += c * (1 + c)
+            d_s_so_far += 1 + c  # source's paper sees colsum 1+c
+        r_t = d_t - used
+        if r_t:
+            f = f"venue_fill_{tid}"
+            rows.append((f, 1))
+            crowd.append((f, r_t - 1))
+        core.append((tid, label, rows))
+    # close the source's own global walk with a private filler venue
+    r_s = source_walk - d_s_so_far
+    if r_s < 1:
+        raise ValueError("source residual exhausted by shared venues")
+    src_venues.append(("venue_fill_source", 1))
+    crowd.append(("venue_fill_source", r_s - 1))
+    core.append((SOURCE_ID, SOURCE_LABEL, src_venues))
+
+    # ---- background mass -------------------------------------------------
+    # papers per author ~ lognormal (heavy right tail), venue choice
+    # Zipf(1.1) over the background venues — the synthetic generator's
+    # DBLP-shaped assumptions, at reconstruction scale.
+    n_bg = args.authors
+    papers_per = np.maximum(
+        1, rng.lognormal(np.log(args.mean_papers), 0.9, n_bg).astype(int)
+    )
+    zipf_w = 1.0 / np.arange(1, args.bg_venues + 1) ** 1.1
+    zipf_w /= zipf_w.sum()
+    # crowd incidences: spread each filler venue's mass over dedicated
+    # crowd authors at ≤3 papers each (no 11k-paper monster authors)
+    crowd_rows: list[tuple[int, str, int]] = []  # (crowd author, venue, k)
+    n_crowd = 0
+    for venue, total in crowd:
+        left = total
+        while left > 0:
+            take = int(min(left, rng.integers(1, 4)))
+            crowd_rows.append((n_crowd, venue, take))
+            n_crowd += 1
+            left -= take
+
+    # ---- stream the GEXF -------------------------------------------------
+    out = pathlib.Path(args.out)
+    n_papers = 0
+    with out.open("w", encoding="utf-8") as f:
+        f.write("<?xml version='1.0' encoding='utf-8'?>\n")
+        f.write('<gexf version="1.2" xmlns="http://www.gexf.net/1.2draft">\n')
+        f.write('  <graph defaultedgetype="directed" mode="static" '
+                'name="dblp_large_reconstructed_20180417">\n')
+        f.write('    <attributes class="edge" mode="static">\n'
+                '      <attribute id="1" title="label" type="string" />\n'
+                "    </attributes>\n")
+        f.write('    <attributes class="node" mode="static">\n'
+                '      <attribute id="0" title="node_type" type="string" />\n'
+                "    </attributes>\n")
+        f.write("    <nodes>\n")
+
+        def node(nid, label, typ):
+            label = (label.replace("&", "&amp;").replace("<", "&lt;")
+                     .replace('"', "&quot;"))
+            f.write(f'      <node id="{nid}" label="{label}"><attvalues>'
+                    f'<attvalue for="0" value="{typ}" /></attvalues>'
+                    "</node>\n")
+
+        edges: list[tuple[str, str, str]] = []
+        venues_seen: dict[str, None] = {}
+
+        def paper_of(author_node: str, venue: str, count: int):
+            nonlocal n_papers
+            venues_seen.setdefault(venue, None)
+            for _ in range(count):
+                pid = f"paper_{n_papers}"
+                n_papers += 1
+                node(pid, pid, "paper")
+                edges.append((author_node, pid, "author_of"))
+                edges.append((pid, venue, "submit_at"))
+
+        # constrained core first (the ids the log names)
+        for tid, label, rows in core:
+            node(tid, label, "author")
+            for venue, count in rows:
+                paper_of(tid, venue, count)
+        # crowd authors behind the filler venues
+        for ci, venue, take in crowd_rows:
+            aid = f"author_crowd_{ci}"
+            node(aid, aid, "author")
+            paper_of(aid, venue, take)
+        # background
+        bg_venue_ids = [f"venue_bg_{i}" for i in range(args.bg_venues)]
+        for a in range(n_bg):
+            aid = f"author_bg_{a}"
+            node(aid, aid, "author")
+            k = int(papers_per[a])
+            for v in rng.choice(args.bg_venues, size=k, p=zipf_w):
+                paper_of(aid, bg_venue_ids[v], 1)
+        for v in venues_seen:
+            node(v, v, "venue")
+        f.write("    </nodes>\n    <edges>\n")
+        for i, (s, d, rel) in enumerate(edges):
+            f.write(f'      <edge id="{i}" source="{s}" target="{d}">'
+                    f'<attvalues><attvalue for="1" value="{rel}" />'
+                    "</attvalues></edge>\n")
+        f.write("    </edges>\n  </graph>\n</gexf>\n")
+
+    n_authors = len(core) + n_crowd + n_bg
+    record = {
+        "metric": "dblp_large_reconstruction",
+        "out": str(out),
+        "authors": n_authors,
+        "papers": n_papers,
+        "venues": len(venues_seen),
+        "bytes": out.stat().st_size,
+        "constrained_targets": len(targets),
+        "source_walk": source_walk,
+        "seconds_build": round(time.time() - t0, 1),
+    }
+
+    if args.verify:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        from distributed_pathsim_tpu.engine import load_dataset
+        from distributed_pathsim_tpu.ops import sparse as sp
+        from distributed_pathsim_tpu.ops.metapath import compile_metapath
+
+        hin = load_dataset(str(out))
+        mp = compile_metapath("APVPA", hin.schema)
+        coo = sp.half_chain_coo(hin, mp).summed()
+        c = np.zeros(coo.shape)
+        c[coo.rows, coo.cols] = coo.weights
+        d = c @ c.sum(axis=0)
+        idx = hin.indices["author"]
+        s_i = idx.index_of[SOURCE_ID]
+        assert int(d[s_i]) == source_walk, (d[s_i], source_walk)
+        worst = 0.0
+        for tid, label, m_t, d_t, score in targets:
+            t_i = idx.index_of[tid]
+            assert idx.labels[t_i] == label, (idx.labels[t_i], label)
+            assert int(d[t_i]) == d_t, (tid, d[t_i], d_t)
+            m = float(c[s_i] @ c[t_i])
+            assert int(m) == m_t, (tid, m, m_t)
+            if score is None:  # truncated 82nd stage: no score logged
+                continue
+            ours = 2.0 * m / (d[s_i] + d[t_i]) if (d[s_i] + d[t_i]) else 0.0
+            worst = max(worst, abs(ours - score))
+        record["verified_targets"] = len(targets)
+        record["max_score_delta_vs_2018_log"] = worst
+        # venue-degree skew vs the Zipf assumption
+        colsum = c.sum(axis=0)
+        record["max_venue_colsum"] = int(colsum.max())
+        record["median_venue_colsum"] = float(np.median(colsum[colsum > 0]))
+
+    print(json.dumps(record), flush=True)
+    return record
+
+
+if __name__ == "__main__":
+    main()
