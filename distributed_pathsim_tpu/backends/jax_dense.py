@@ -173,9 +173,15 @@ class JaxDenseBackend(PathSimBackend):
             # identity) — materializing M here would be O(N²) memory
             # and crashes outright at reconstruction scale (a 227k-
             # author single-source query is a 206 GB M).
-            c, _ = self._half()
+            c, rowsums = self._half()
             with jax.default_matmul_precision("highest"):
                 row = chain.pairwise_row_from_half(c, source_index, xp=jnp)
+            # same exactness contract as every other primitive: the
+            # f32 2^24 guard must hold even when pairwise_row is the
+            # FIRST (or only) call on this backend
+            if self._rowsums is None:
+                self._rowsums = np.asarray(rowsums, dtype=np.float64)
+                self._check_exact(self._rowsums)
             return np.asarray(row, dtype=np.float64)
         return self._compute()[0][source_index]
 

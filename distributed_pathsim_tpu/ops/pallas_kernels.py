@@ -96,7 +96,12 @@ def fused_scores(c: jax.Array, rowsums: jax.Array, interpret: bool = False,
     bm = _BM if bm is None else bm
     bn = _BN if bn is None else bn
     n, v = c.shape
-    n_pad = _ceil_to(max(n, 8), max(bm, bn))
+    # pad to a multiple of BOTH tile dims: the grid floor-divides by
+    # each, and a pad that only covers the larger one would leave
+    # output tiles unwritten for non-dividing (bm, bn) pairs
+    import math
+
+    n_pad = _ceil_to(max(n, 8), math.lcm(bm, bn))
     v_pad = _ceil_to(max(v, 128), 128)
     c_p = jnp.zeros((n_pad, v_pad), dtype=jnp.float32).at[:n, :v].set(c)
     d_p = jnp.zeros((n_pad, 1), dtype=jnp.float32).at[:n, 0].set(rowsums)

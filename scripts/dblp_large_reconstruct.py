@@ -36,7 +36,8 @@ Construction per target t (exact integer bookkeeping):
   - the source's own d_s closes the same way after all targets.
 
 Usage: python scripts/dblp_large_reconstruct.py [--authors N]
-         [--out PATH] [--verify] [--platform cpu]
+         [--out PATH] [--log REF_LOG] [--verify]
+(verification pins jax to the CPU host — never a tunnel client)
 """
 
 from __future__ import annotations
@@ -107,13 +108,15 @@ def main(argv=None) -> dict:
     ap.add_argument("--bg-venues", type=int, default=380)
     ap.add_argument("--mean-papers", type=float, default=2.6)
     ap.add_argument("--out", default="/tmp/dblp_large_reconstructed.gexf")
+    ap.add_argument("--log", default=REF_LOG,
+                    help="path to the reference's 2018 run log")
     ap.add_argument("--seed", type=int, default=20180417)
     ap.add_argument("--verify", action="store_true",
                     help="load the file back and check every constraint")
     args = ap.parse_args(argv)
 
     rng = np.random.default_rng(args.seed)
-    source_walk, targets = parse_reference_log()
+    source_walk, targets = parse_reference_log(args.log)
     t0 = time.time()
 
     # ---- constrained core ------------------------------------------------
@@ -213,14 +216,21 @@ def main(argv=None) -> dict:
             aid = f"author_crowd_{ci}"
             node(aid, aid, "author")
             paper_of(aid, venue, take)
-        # background
+        # background — one vectorized Zipf draw for every paper (a
+        # per-author rng.choice would rebuild the CDF machinery 200k
+        # times and dominate the build)
         bg_venue_ids = [f"venue_bg_{i}" for i in range(args.bg_venues)]
+        all_draws = rng.choice(
+            args.bg_venues, size=int(papers_per.sum()), p=zipf_w
+        )
+        draw_at = 0
         for a in range(n_bg):
             aid = f"author_bg_{a}"
             node(aid, aid, "author")
             k = int(papers_per[a])
-            for v in rng.choice(args.bg_venues, size=k, p=zipf_w):
+            for v in all_draws[draw_at : draw_at + k]:
                 paper_of(aid, bg_venue_ids[v], 1)
+            draw_at += k
         for v in venues_seen:
             node(v, v, "venue")
         f.write("    </nodes>\n    <edges>\n")

@@ -521,3 +521,16 @@ def test_dense_topk_rect_gate_respects_mask_and_dtype(monkeypatch):
     np.testing.assert_allclose(
         vals[0].astype(np.float64), np.sort(scores[0])[::-1][:3], atol=1e-6
     )
+
+
+def test_fused_scores_tile_overrides(cd):
+    """bm/bn sweep configs (incl. a non-dividing pair, which exercises
+    the lcm padding) must agree with the default tiling exactly."""
+    c, d, oracle = cd
+    want = oracle.all_pairs_scores()
+    for bm, bn in ((512, 512), (256, 512), (256, 384)):
+        got = np.asarray(
+            pk.fused_scores(c, d, interpret=True, bm=bm, bn=bn),
+            dtype=np.float64,
+        )
+        np.testing.assert_allclose(got, want, atol=1e-7, err_msg=f"{bm}x{bn}")
