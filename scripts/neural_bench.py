@@ -17,6 +17,7 @@ from __future__ import annotations
 import argparse
 import json
 import pathlib
+import resource
 import sys
 import time
 
@@ -138,6 +139,15 @@ def main(argv=None) -> dict:
         "seconds_per_query": round(t_query, 5),
         "eval_sources": args.eval_sources,
         "recall_min": float(np.min(recalls)),
+        # proves the sparse build path: the r03 trainer materialized a
+        # dense [N, P] block (~86 GB at the 65k bench shape) and could
+        # not reach the million-author regime at all. Same KiB→GiB
+        # conversion as scale_config5._peak_rss_gb so the benches'
+        # memory numbers stay comparable.
+        "peak_rss_gb": round(
+            resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / (1 << 20),
+            2,
+        ),
     }
     line = json.dumps(record)
     print(line, flush=True)
