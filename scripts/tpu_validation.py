@@ -167,6 +167,33 @@ def main() -> int:
     )
     check("rect twopass wide-V (384) vs dense f64", ok_w, "N=4000, k=10")
 
+    # -- rect kernel inside shard_map (the sharded tier's ring fold) -----
+    # A 1-device mesh compiles the real Mosaic kernel under shard_map on
+    # chip (virtual-mesh tests only ever run it in interpret mode); the
+    # ring degenerates to one step, so results must equal the dense
+    # fused path bit-for-bit at the value level.
+    from distributed_pathsim_tpu.parallel.mesh import make_mesh
+    from distributed_pathsim_tpu.parallel.sharded import (
+        shard_first_block_rows,
+        sharded_topk,
+    )
+
+    ap_b = hin.block("author_of").to_dense(np.float32)
+    pv_b = hin.block("submit_at").to_dense(np.float32)
+    mesh1 = make_mesh(1)
+    first = shard_first_block_rows(np.asarray(ap_b @ pv_b, np.float32), mesh1)
+    rv, ri = sharded_topk(
+        first, (), mesh=mesh1, k=5, n_true=first.shape[0],
+        use_pallas=True,
+    )
+    want_v, want_i = create_backend("jax", hin, mp).topk(k=5)
+    check(
+        "ring shard_map rect kernel vs dense fused topk",
+        bool(np.allclose(np.asarray(rv)[: want_v.shape[0]], want_v,
+                         atol=1e-6)),
+        "1-device mesh, k=5, dblp_small",
+    )
+
     if quick:
         print("quick mode: skipping timing sweep", flush=True)
         return failures
