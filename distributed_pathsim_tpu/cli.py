@@ -373,10 +373,9 @@ def _run_multipath(args) -> int:
     from .engine import load_dataset
     from .models.multipath import MultiMetapathScorer
 
-    # The batched scorer is a fixed jax/rowsum pipeline; reject flags it
+    # The batched scorer is a fixed jax pipeline; reject flags it
     # would otherwise silently ignore.
     unsupported = {
-        "--variant": args.variant != "rowsum",
         "--backend": args.backend != "jax",
         "--dtype": args.dtype != "float32",
         "--output": args.output is not None,
@@ -390,7 +389,7 @@ def _run_multipath(args) -> int:
     if bad:
         raise ValueError(
             f"multi-metapath mode does not support {', '.join(bad)} "
-            "(it always runs the batched jax rowsum-variant scorer)"
+            "(it always runs the batched jax scorer)"
         )
     if args.n_devices is not None and not (
         args.top_k and not (args.source or args.source_id)
@@ -414,12 +413,16 @@ def _run_multipath(args) -> int:
     weights = (
         [float(w) for w in args.weights.split(",")] if args.weights else None
     )
-    scorer = MultiMetapathScorer(hin, names)
+    scorer = MultiMetapathScorer(hin, names, variant=args.variant)
     if not args.quiet:
         print(f"Batched metapaths: {scorer.names}")
         gw = scorer.global_walks()
+        denom_label = (
+            "max global walk" if args.variant == "rowsum"
+            else "max diag(M)"
+        )
         for r, name in enumerate(scorer.names):
-            print(f"  {name}: max global walk {int(gw[r].max())}")
+            print(f"  {name}: {denom_label} {int(gw[r].max())}")
 
     ran = False
     if args.source or args.source_id:

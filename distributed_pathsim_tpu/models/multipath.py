@@ -26,17 +26,23 @@ from ..ops import chain
 from ..ops.metapath import MetaPath, compile_metapath
 
 
-@functools.partial(jax.jit, static_argnames=())
-def _batched_scores(c_stack: jax.Array):
-    """[R, N, V] → (scores [R, N, N], rowsums [R, N]) under rowsum
-    normalization, all on device."""
+@functools.partial(jax.jit, static_argnames=("variant",))
+def _batched_scores(c_stack: jax.Array, variant: str = "rowsum"):
+    """[R, N, V] → (scores [R, N, N], denominators [R, N]), all on
+    device. "rowsum" is reference semantics; "diagonal" is textbook
+    PathSim — per path, diag(M_r) = Σ_v C_r², no extra matmul."""
     with jax.default_matmul_precision("highest"):
         m = jnp.einsum("rnv,rmv->rnm", c_stack, c_stack)
-        colsums = jnp.sum(c_stack, axis=1)  # [R, V]
-        rowsums = jnp.einsum("rnv,rv->rn", c_stack, colsums)
-    denom = rowsums[:, :, None] + rowsums[:, None, :]
+        if variant == "rowsum":
+            colsums = jnp.sum(c_stack, axis=1)  # [R, V]
+            d = jnp.einsum("rnv,rv->rn", c_stack, colsums)
+        elif variant == "diagonal":
+            d = jnp.einsum("rnv,rnv->rn", c_stack, c_stack)
+        else:
+            raise ValueError(f"unknown PathSim variant {variant!r}")
+    denom = d[:, :, None] + d[:, None, :]
     scores = jnp.where(denom > 0, 2.0 * m / jnp.where(denom > 0, denom, 1.0), 0.0)
-    return scores, rowsums
+    return scores, d
 
 
 @jax.jit
@@ -44,8 +50,11 @@ def _combine(scores: jax.Array, weights: jax.Array):
     return jnp.einsum("rnm,r->nm", scores, weights)
 
 
-@functools.partial(jax.jit, static_argnames=("mesh", "k", "n_true"))
-def _sharded_combined_topk(c_stack, weights, mesh, k: int, n_true: int):
+@functools.partial(
+    jax.jit, static_argnames=("mesh", "k", "n_true", "variant")
+)
+def _sharded_combined_topk(c_stack, weights, mesh, k: int, n_true: int,
+                           variant: str = "rowsum"):
     """Distributed weighted multi-path top-k: the author axis of the
     stacked half-chain factors [R, N_pad, V] is row-sharded over ``dp``;
     each device scores its row block of ALL R paths in one batched
@@ -69,8 +78,13 @@ def _sharded_combined_topk(c_stack, weights, mesh, k: int, n_true: int):
         n_loc = c_loc.shape[1]
         my = jax.lax.axis_index("dp")
         with jax.default_matmul_precision("highest"):
-            colsums = jax.lax.psum(jnp.sum(c_loc, axis=1), "dp")  # [R, V]
-            d_loc = jnp.einsum("rnv,rv->rn", c_loc, colsums)
+            if variant == "rowsum":
+                colsums = jax.lax.psum(jnp.sum(c_loc, axis=1), "dp")
+                d_loc = jnp.einsum("rnv,rv->rn", c_loc, colsums)
+            elif variant == "diagonal":  # purely local, no collective
+                d_loc = jnp.einsum("rnv,rnv->rn", c_loc, c_loc)
+            else:
+                raise ValueError(f"unknown PathSim variant {variant!r}")
             c_full = jax.lax.all_gather(c_loc, "dp", axis=1, tiled=True)
             d_full = jax.lax.all_gather(d_loc, "dp", axis=1, tiled=True)
             m = jnp.einsum("rnv,rmv->rnm", c_loc, c_full)  # [R, n_loc, N]
@@ -94,7 +108,15 @@ class MultiMetapathScorer:
         hin: EncodedHIN,
         metapaths: Sequence[MetaPath | str],
         dtype=jnp.float32,
+        variant: str = "rowsum",
     ):
+        from ..ops.pathsim import VARIANTS
+
+        if variant not in VARIANTS:
+            raise ValueError(
+                f"unknown PathSim variant {variant!r}; choose {VARIANTS}"
+            )
+        self.variant = variant
         self.hin = hin
         self.metapaths: list[MetaPath] = [
             compile_metapath(m, hin.schema) if isinstance(m, str) else m
@@ -112,13 +134,13 @@ class MultiMetapathScorer:
         self.n = hin.type_size(self.metapaths[0].source_type)
         # Per-path half factors on host (shapes differ per path), padded
         # to a common contraction width and stacked for the batched einsum.
-        cs = []
-        for m in self.metapaths:
-            blocks = chain.oriented_dense_blocks(hin, m.half(), dtype=np.float32)
-            c = blocks[0]
-            for b in blocks[1:]:
-                c = c @ b
-            cs.append(c)
+        # Sparse half-chain folds: each C_r densifies straight to
+        # [N, V_r] (the dense [N, P] intermediate of a naive chain
+        # product never exists — same discipline as the backends and
+        # the neural trainer).
+        from ..ops import sparse as sp
+
+        cs = [sp.dense_half_chain(hin, m) for m in self.metapaths]
         vmax = max(c.shape[1] for c in cs)
         stack = np.zeros((len(cs), self.n, vmax), dtype=np.float32)
         for r, c in enumerate(cs):
@@ -133,7 +155,7 @@ class MultiMetapathScorer:
 
     def _compute(self):
         if self._scores is None:
-            s, d = _batched_scores(self._c_stack)
+            s, d = _batched_scores(self._c_stack, variant=self.variant)
             self._scores = np.asarray(s)
             self._rowsums = np.asarray(d, dtype=np.float64)
             chain.check_exact_counts(
@@ -146,7 +168,8 @@ class MultiMetapathScorer:
         return self._compute()[0]
 
     def global_walks(self) -> np.ndarray:
-        """[R, N] per-path row sums (the reference's global walks)."""
+        """[R, N] per-path denominators (the reference's global walks
+        under "rowsum"; diag(M_r) under "diagonal")."""
         return self._compute()[1]
 
     def _resolve_weights(self, weights: Sequence[float] | None) -> np.ndarray:
@@ -204,7 +227,8 @@ class MultiMetapathScorer:
         if n_pad != self.n:
             stack = jnp.pad(stack, ((0, 0), (0, n_pad - self.n), (0, 0)))
         vals, idxs = _sharded_combined_topk(
-            stack, jnp.asarray(w), mesh, k=min(k, self.n - 1), n_true=self.n
+            stack, jnp.asarray(w), mesh, k=min(k, self.n - 1),
+            n_true=self.n, variant=self.variant,
         )
         return (
             np.asarray(vals, dtype=np.float64)[: self.n],

@@ -94,14 +94,11 @@ class NeuralPathSim:
             raise ValueError("NeuralPathSim needs a symmetric metapath")
         self.mesh = mesh
 
-        # Sparse half-chain fold: C arrives as summed COO and densifies
-        # straight to [N, V] (V is the small contraction width). The
-        # dense [N, P] intermediate of a naive left-to-right chain
-        # product would be ~86 GB at the 65k x 327k bench shape —
+        # Sparse half-chain fold straight to [N, V] (V is the small
+        # contraction width). The dense [N, P] intermediate of a naive
+        # chain product would be ~86 GB at the 65k x 327k bench shape —
         # backends/jax_dense.py:94 refuses it for the same reason.
-        coo = sp.half_chain_coo(hin, self.metapath).summed()
-        c = np.zeros(coo.shape, dtype=np.float32)
-        c[coo.rows, coo.cols] = coo.weights
+        c = sp.dense_half_chain(hin, self.metapath)
         self._setup_from_c(c, dim=dim, hidden=hidden, lr=lr, seed=seed)
 
     # Quadrature width for the structural index: m log-spaced nodes

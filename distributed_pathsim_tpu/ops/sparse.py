@@ -111,6 +111,16 @@ def fold_half_chain(blocks) -> COOMatrix:
     return acc
 
 
+def dense_half_chain(hin, metapath, dtype=np.float32) -> np.ndarray:
+    """Dense [N, V] half-chain factor via the sparse fold — the dense
+    [N, P] intermediate of a naive chain product never exists. Shared
+    by the model layer (neural + multipath scorers)."""
+    coo = half_chain_coo(hin, metapath).summed()
+    c = np.zeros(coo.shape, dtype=dtype)
+    c[coo.rows, coo.cols] = coo.weights
+    return c
+
+
 def half_chain_coo(hin, metapath) -> COOMatrix:
     """Host-folded COO half-chain factor C for a symmetric metapath.
 

@@ -99,11 +99,23 @@ def test_multipath_rejects_unsupported_flags(dblp_small_path, capsys):
     rc = main([
         "--dataset", dblp_small_path,
         "--metapath", "APVPA,APA",
-        "--variant", "diagonal",
+        "--approx",
         "--all-pairs", "--quiet",
     ])
     assert rc == 1
-    assert "--variant" in capsys.readouterr().err
+    assert "--approx" in capsys.readouterr().err
+
+
+def test_multipath_diagonal_variant(dblp_small_path, capsys):
+    """--variant diagonal rides the batched multipath scorer (r04)."""
+    rc = main([
+        "--dataset", dblp_small_path,
+        "--metapath", "APVPA,APA",
+        "--variant", "diagonal",
+        "--all-pairs", "--quiet",
+    ])
+    assert rc == 0
+    assert "Combined all-pairs scores: 770x770" in capsys.readouterr().out
 
 
 def test_ranking_flags_require_top_k(dblp_small_path, capsys):

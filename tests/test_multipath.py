@@ -113,3 +113,30 @@ def test_topk_sharded_uneven_rows(dblp_small_hin):
     np.testing.assert_allclose(got_v, want_v, atol=1e-6)
     assert got_v.shape == (770, 3)
     assert int(got_i.max()) < 770
+
+
+def test_diagonal_variant_matches_per_path_oracle(dblp_small_hin):
+    """Diagonal multipath == per-path diagonal scores from the exact
+    backend, combined with the same weights; sharded == host."""
+    from distributed_pathsim_tpu.backends.base import create_backend
+    from distributed_pathsim_tpu.models.multipath import MultiMetapathScorer
+    from distributed_pathsim_tpu.ops.metapath import compile_metapath
+
+    names = ["APVPA", "APA"]
+    sc = MultiMetapathScorer(dblp_small_hin, names, variant="diagonal")
+    w = [0.7, 0.3]
+    combined = sc.combined_scores(w)
+    want = np.zeros_like(combined, dtype=np.float64)
+    for wi, nm in zip(w, names):
+        b = create_backend(
+            "numpy", dblp_small_hin, compile_metapath(nm, dblp_small_hin.schema)
+        )
+        want += wi * b.all_pairs_scores(variant="diagonal")
+    np.testing.assert_allclose(combined.astype(np.float64), want, atol=1e-6)
+
+    import jax
+
+    if len(jax.devices()) >= 8:
+        hv, hi = sc.topk(k=5, weights=w)
+        sv, si = sc.topk_sharded(k=5, weights=w, n_devices=8)
+        np.testing.assert_allclose(sv, hv, atol=1e-6)
