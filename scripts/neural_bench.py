@@ -28,6 +28,9 @@ sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
 
 def main(argv=None) -> dict:
     p = argparse.ArgumentParser()
+    p.add_argument("--dataset", default=None,
+                   help="GEXF path (e.g. the dblp_large reconstruction); "
+                   "default builds the synthetic DBLP-shaped HIN below")
     p.add_argument("--authors", type=int, default=65536)
     p.add_argument("--papers", type=int, default=327680)
     p.add_argument("--venues", type=int, default=64)
@@ -54,7 +57,13 @@ def main(argv=None) -> dict:
     if args.platform == "tpu" and dev.platform != "tpu":
         raise RuntimeError(f"--platform tpu but JAX resolved to {dev.platform}")
 
-    hin = synthetic_hin(args.authors, args.papers, args.venues, seed=42)
+    if args.dataset:
+        from distributed_pathsim_tpu.engine import load_dataset
+
+        hin = load_dataset(args.dataset)
+        args.authors = hin.type_size("author")
+    else:
+        hin = synthetic_hin(args.authors, args.papers, args.venues, seed=42)
     model = NeuralPathSim(hin, "APVPA", dim=args.dim, hidden=args.hidden)
 
     t0 = time.perf_counter()
@@ -117,6 +126,7 @@ def main(argv=None) -> dict:
         "unit": "recall",
         "vs_baseline": None,
         "config": {
+            "dataset": args.dataset or "synthetic",
             "authors": args.authors,
             "papers": args.papers,
             "venues": args.venues,
