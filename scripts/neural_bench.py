@@ -62,6 +62,8 @@ def main(argv=None) -> dict:
 
         hin = load_dataset(args.dataset)
         args.authors = hin.type_size("author")
+        args.papers = hin.type_size("paper")
+        args.venues = hin.type_size("venue")
     else:
         hin = synthetic_hin(args.authors, args.papers, args.venues, seed=42)
     model = NeuralPathSim(hin, "APVPA", dim=args.dim, hidden=args.hidden)
