@@ -1,0 +1,180 @@
+"""One-shot TPU capture sequence for a healthy tunnel window.
+
+The tunnel protocol (DESIGN.md §8) makes interactive capture risky: a
+window can open and close while a human (or agent) is mid-task, and
+every stage must run as its own never-signalled, self-alarming client.
+This orchestrator runs the full round-capture sequence the moment it is
+invoked, stage by stage:
+
+  1. validation  — scripts/tpu_validation.py --quick (must ALL PASS)
+  2. bench       — python bench.py (its own probe+retry protocol)
+  3. kernels     — scripts/kernel_bench.py --sweep-tiles
+  4. realdata    — product CLI on the dblp_large reconstruction
+  5. neural      — scripts/neural_bench.py on TPU (65k shape)
+  6. scale       — scripts/scale_config5.py --approx (1M streaming)
+
+Rules enforced here (never violated):
+  - ONE tunnel client at a time; the orchestrator itself NEVER imports
+    jax (it only spawns children).
+  - every child carries its own signal.alarm and is never signalled
+    from outside; an overstayed child is ABANDONED and the sequence
+    aborts (launching behind a hung client would make two).
+  - a child that exits nonzero aborts the sequence (a sick tunnel
+    wastes every later stage's alarm budget) unless --keep-going.
+
+Usage: python scripts/tpu_capture_all.py [--out-dir artifacts]
+         [--stages validation,bench,...] [--keep-going]
+Writes artifacts/capture_log_r04.txt with per-stage outcomes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import time
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+# (name, alarm seconds, argv builder). Children run through the
+# self-alarm wrapper below; bench.py manages its own children and runs
+# directly (it never touches the TPU from its parent process).
+def _stages(out_dir: pathlib.Path, gexf: str):
+    return [
+        ("validation", 900,
+         ["scripts/tpu_validation.py", "--quick"]),
+        ("bench", 0,  # bench.py self-manages (probe + alarmed children)
+         ["bench.py"]),
+        ("kernels", 2700,
+         ["scripts/kernel_bench.py", "--sweep-tiles",
+          "--out", str(out_dir / "KERNELS_r04.json")]),
+        ("realdata", 1800,
+         ["-m", "distributed_pathsim_tpu.cli",
+          "--dataset", gexf, "--backend", "jax", "--platform", "tpu",
+          "--source", "Jiawei Han",
+          "--output", str(out_dir / "cli_tpu_realdata_run.log"),
+          "--quiet"]),
+        ("neural", 2700,
+         ["scripts/neural_bench.py", "--platform", "tpu",
+          "--steps", "1500", "--batch", "8192", "--dim", "128",
+          "--hidden", "256",
+          "--out", str(out_dir / "NEURAL_r04_TPU.json")]),
+        ("scale", 2700,
+         ["scripts/scale_config5.py", "--platform", "tpu", "--approx",
+          "--out", str(out_dir / "SCALE_r04_TPU.json")]),
+    ]
+
+
+_WRAPPER = """
+import os, runpy, signal, sys
+os.chdir({repo!r})
+sys.path.insert(0, os.getcwd())
+signal.signal(signal.SIGALRM, lambda *_: sys.exit(3))
+signal.alarm({alarm})
+sys.argv = {argv!r}
+if {argv!r}[0] == "-m":
+    sys.argv = {argv!r}[1:]
+    runpy.run_module({argv!r}[1], run_name="__main__")
+else:
+    runpy.run_path({argv!r}[0], run_name="__main__")
+"""
+
+
+def run_stage(name, alarm, argv, out_dir, log) -> str:
+    """Returns 'ok' | 'failed' | 'overstayed'."""
+    stage_log = out_dir / f"capture_{name}.txt"
+    t0 = time.monotonic()
+    with open(stage_log, "w", encoding="utf-8") as f:
+        if alarm == 0:  # bench.py: own protocol, generous outer wait
+            proc = subprocess.Popen(
+                [sys.executable, str(REPO / argv[0])],
+                stdout=f, stderr=subprocess.STDOUT,
+                cwd=str(REPO), start_new_session=True,
+            )
+            deadline = time.monotonic() + 3600
+        else:
+            code = _WRAPPER.format(repo=str(REPO), alarm=alarm, argv=argv)
+            proc = subprocess.Popen(
+                [sys.executable, "-c", code],
+                stdout=f, stderr=subprocess.STDOUT,
+                cwd=str(REPO), start_new_session=True,
+            )
+            deadline = time.monotonic() + alarm + 180
+        rc = None
+        while time.monotonic() < deadline:
+            rc = proc.poll()
+            if rc is not None:
+                break
+            time.sleep(5)
+        if rc is None:
+            rc = proc.poll()  # may have exited during the last sleep
+    dt = time.monotonic() - t0
+    if rc is None:
+        outcome = "overstayed"  # ABANDONED, never killed
+    elif rc == 0:
+        outcome = "ok"
+    else:
+        outcome = f"failed rc={rc}"
+    line = f"{name}: {outcome} ({dt:.0f}s) -> {stage_log.name}"
+    print(line, flush=True)
+    log.write(line + "\n")
+    log.flush()
+    return outcome
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out-dir", default=str(REPO / "artifacts"))
+    ap.add_argument("--gexf", default="/tmp/dblp_large_reconstructed.gexf")
+    ap.add_argument("--stages", default=None,
+                    help="comma list; default = all in order")
+    ap.add_argument("--keep-going", action="store_true",
+                    help="continue after a FAILED stage (never after an "
+                    "overstayed one — that means a wedged client is "
+                    "still holding the tunnel)")
+    args = ap.parse_args()
+
+    out_dir = pathlib.Path(args.out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    all_names = [n for n, _, _ in _stages(out_dir, args.gexf)]
+    if args.stages:
+        wanted = [t.strip() for t in args.stages.split(",") if t.strip()]
+        unknown = [t for t in wanted if t not in all_names]
+        if unknown:
+            ap.error(f"unknown stage(s) {unknown}; choose from {all_names}")
+    else:
+        wanted = None
+    if (wanted is None or "realdata" in wanted) and not os.path.exists(
+        args.gexf
+    ):
+        print(f"# regenerating {args.gexf} (reconstruction artifact)",
+              flush=True)
+        subprocess.run(
+            [sys.executable, str(REPO / "scripts/dblp_large_reconstruct.py"),
+             "--authors", "200000", "--out", args.gexf],
+            cwd=str(REPO), check=True,
+        )
+
+    results = {}
+    with open(out_dir / "capture_log_r04.txt", "a", encoding="utf-8") as log:
+        log.write(f"# capture sequence started {time.ctime()}\n")
+        for name, alarm, argv in _stages(out_dir, args.gexf):
+            if wanted and name not in wanted:
+                continue
+            outcome = run_stage(name, alarm, argv, out_dir, log)
+            results[name] = outcome
+            if outcome == "overstayed":
+                log.write("# aborting: a wedged client holds the tunnel\n")
+                break
+            if outcome != "ok" and not args.keep_going:
+                log.write("# aborting on failure (no --keep-going)\n")
+                break
+    print(json.dumps(results), flush=True)
+    return 0 if all(v == "ok" for v in results.values()) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
