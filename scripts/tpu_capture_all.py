@@ -74,12 +74,13 @@ os.chdir({repo!r})
 sys.path.insert(0, os.getcwd())
 signal.signal(signal.SIGALRM, lambda *_: sys.exit(3))
 signal.alarm({alarm})
-sys.argv = {argv!r}
-if {argv!r}[0] == "-m":
-    sys.argv = {argv!r}[1:]
-    runpy.run_module({argv!r}[1], run_name="__main__")
+argv = {argv!r}
+if argv[0] == "-m":
+    sys.argv = argv[1:]
+    runpy.run_module(argv[1], run_name="__main__")
 else:
-    runpy.run_path({argv!r}[0], run_name="__main__")
+    sys.argv = argv
+    runpy.run_path(argv[0], run_name="__main__")
 """
 
 
@@ -116,6 +117,19 @@ def run_stage(name, alarm, argv, out_dir, log) -> str:
         outcome = "overstayed"  # ABANDONED, never killed
     elif rc == 0:
         outcome = "ok"
+        if name == "bench":
+            # bench.py exits 0 even on its CPU fallback; if the
+            # fallback was caused by an OVERSTAYED (wedged) child, a
+            # hung client still holds the tunnel and no further stage
+            # may launch behind it.
+            try:
+                tail = stage_log.read_text(encoding="utf-8")
+            except OSError:
+                tail = ""
+            if "overstayed_tunnel_wedged" in tail:
+                outcome = "overstayed"
+            elif "fallback_reason" in tail:
+                outcome = "failed cpu_fallback"
     else:
         outcome = f"failed rc={rc}"
     line = f"{name}: {outcome} ({dt:.0f}s) -> {stage_log.name}"
@@ -140,11 +154,13 @@ def main() -> int:
     out_dir = pathlib.Path(args.out_dir)
     out_dir.mkdir(parents=True, exist_ok=True)
     all_names = [n for n, _, _ in _stages(out_dir, args.gexf)]
-    if args.stages:
+    if args.stages is not None:
         wanted = [t.strip() for t in args.stages.split(",") if t.strip()]
         unknown = [t for t in wanted if t not in all_names]
         if unknown:
             ap.error(f"unknown stage(s) {unknown}; choose from {all_names}")
+        if not wanted:
+            ap.error(f"empty --stages; choose from {all_names}")
     else:
         wanted = None
     if (wanted is None or "realdata" in wanted) and not os.path.exists(
