@@ -1,0 +1,83 @@
+"""Capture-orchestrator protocol: stage outcomes, the bench fallback
+inspection, and stage-name validation. The orchestrator guards the
+single-client tunnel rule, so its dispatch logic gets real tests, not
+just smoke runs (the TPU stages themselves run only on hardware)."""
+
+import pathlib
+import sys
+
+import pytest
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1] / "scripts"))
+
+import tpu_capture_all as cap  # noqa: E402
+
+
+@pytest.fixture()
+def outdir(tmp_path):
+    return tmp_path
+
+
+def _script(tmp_path, body: str) -> str:
+    p = tmp_path / "stage.py"
+    p.write_text(body)
+    return str(p)
+
+
+def test_ok_stage(outdir, tmp_path):
+    log = open(outdir / "log.txt", "w")
+    s = _script(tmp_path, "print('fine')")
+    assert cap.run_stage("validation", 60, [s], outdir, log) == "ok"
+    assert "fine" in (outdir / "capture_validation.txt").read_text()
+
+
+def test_failed_stage(outdir, tmp_path):
+    log = open(outdir / "log.txt", "w")
+    s = _script(tmp_path, "import sys; sys.exit(7)")
+    assert cap.run_stage("kernels", 60, [s], outdir, log) == "failed rc=7"
+
+
+def test_module_stage_argv(outdir, tmp_path):
+    """-m stages run through runpy with argv[0] stripped."""
+    log = open(outdir / "log.txt", "w")
+    data = tmp_path / "x.json"
+    data.write_text("{}")
+    out = cap.run_stage(
+        "realdata", 60, ["-m", "json.tool", str(data)], outdir, log
+    )
+    assert out == "ok"
+
+
+def test_bench_wedged_fallback_aborts(outdir, tmp_path):
+    """bench.py exits 0 on CPU fallback; an overstayed-child reason
+    means a hung client still holds the tunnel — the orchestrator must
+    classify it as overstayed (sequence abort), and any other fallback
+    as failed."""
+    log = open(outdir / "log.txt", "w")
+    wedged = _script(
+        tmp_path,
+        "print('{\"metric\": \"m_CPU_FALLBACK\", \"fallback_reason\": "
+        "\"bench_child_overstayed_tunnel_wedged\"}')",
+    )
+    assert cap.run_stage("bench", 60, [wedged], outdir, log) == "overstayed"
+    cpu = _script(
+        tmp_path,
+        "print('{\"metric\": \"m_CPU_FALLBACK\", \"fallback_reason\": "
+        "\"probe_failed_rc3_after_2_attempts\"}')",
+    )
+    assert cap.run_stage("bench", 60, [cpu], outdir, log) == (
+        "failed cpu_fallback"
+    )
+    real = _script(tmp_path, "print('{\"metric\": \"pairs\", \"value\": 1}')")
+    assert cap.run_stage("bench", 60, [real], outdir, log) == "ok"
+
+
+def test_unknown_and_empty_stage_names_rejected(tmp_path, capsys):
+    with pytest.raises(SystemExit):
+        sys.argv = ["tpu_capture_all.py", "--stages", "bogus",
+                    "--out-dir", str(tmp_path)]
+        cap.main()
+    with pytest.raises(SystemExit):
+        sys.argv = ["tpu_capture_all.py", "--stages", " , ",
+                    "--out-dir", str(tmp_path)]
+        cap.main()
