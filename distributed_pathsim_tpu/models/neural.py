@@ -83,6 +83,7 @@ class NeuralPathSim:
         lr: float = 1e-3,
         mesh: Mesh | None = None,
         seed: int = 0,
+        variant: str = "rowsum",
     ):
         self.hin = hin
         self.metapath = (
@@ -99,7 +100,9 @@ class NeuralPathSim:
         # chain product would be ~86 GB at the 65k x 327k bench shape —
         # backends/jax_dense.py:94 refuses it for the same reason.
         c = sp.dense_half_chain(hin, self.metapath)
-        self._setup_from_c(c, dim=dim, hidden=hidden, lr=lr, seed=seed)
+        self._setup_from_c(
+            c, dim=dim, hidden=hidden, lr=lr, seed=seed, variant=variant
+        )
 
     # Quadrature width for the structural index: m log-spaced nodes
     # cover the full observed range of 2·d with ~3% max relative error
@@ -111,6 +114,7 @@ class NeuralPathSim:
 
     def _setup_from_c(
         self, c: np.ndarray, dim: int, hidden: int, lr: float, seed: int,
+        variant: str = "rowsum",
         target_scale: float | None = None,
         quad: tuple[np.ndarray, np.ndarray] | None = None,
     ) -> None:
@@ -120,13 +124,27 @@ class NeuralPathSim:
         restoring a checkpoint: both must match what the params were
         trained against, and a recompute from the f32-cast stored C
         could drift."""
-        self._config = {"dim": dim, "hidden": hidden, "lr": lr, "seed": seed}
+        from ..ops.pathsim import VARIANTS
+
+        if variant not in VARIANTS:
+            raise ValueError(
+                f"unknown PathSim variant {variant!r}; choose {VARIANTS}"
+            )
+        self.variant = variant
+        self._config = {"dim": dim, "hidden": hidden, "lr": lr,
+                        "seed": seed, "variant": variant}
         self.n, self.v = c.shape
-        # Exact targets (rowsum-variant PathSim) are computed ON DEMAND per
-        # batch from the half-chain factor C — never the dense N×N matrix,
-        # so the trainer scales to graphs where exact all-pairs can't exist.
+        # Exact targets are computed ON DEMAND per batch from the
+        # half-chain factor C — never the dense N×N matrix, so the
+        # trainer scales to graphs where exact all-pairs can't exist.
+        # Every downstream structure (quadrature, gates, targets, both
+        # indexes) is generic in the denominator vector, so the variant
+        # choice is made exactly once, here.
         self._c64 = c.astype(np.float64)
-        self._d = self._c64 @ self._c64.sum(axis=0)  # row sums of M = C·Cᵀ
+        if variant == "rowsum":
+            self._d = self._c64 @ self._c64.sum(axis=0)  # rowsums of M
+        else:  # diagonal: diag(M)[i] = Σ_v C[i,v]²
+            self._d = np.einsum("nv,nv->n", self._c64, self._c64)
         # Cauchy-quadrature nodes for the structural index: log-spaced
         # over the observed range of s = d_i + d_j ∈ [2·min d⁺, 2·max d],
         # extended by _QUAD_MARGIN on each side (the trapezoid rule on
@@ -297,8 +315,9 @@ class NeuralPathSim:
         )
 
     def pair_scores(self, i: np.ndarray, j: np.ndarray) -> np.ndarray:
-        """Exact rowsum-variant PathSim for arbitrary pairs, O(batch·V):
-        2·(C[i]·C[j]) / (d[i]+d[j]) — no N×N matrix involved."""
+        """Exact PathSim (this model's variant) for arbitrary pairs,
+        O(batch·V): 2·(C[i]·C[j]) / (d[i]+d[j]) — no N×N matrix
+        involved."""
         i = np.asarray(i)
         j = np.asarray(j)
         num = 2.0 * np.einsum("bv,bv->b", self._c64[i], self._c64[j])
@@ -482,7 +501,7 @@ class NeuralPathSim:
             from ..ops.pathsim import score_matrix
 
             self._scores_cache = score_matrix(
-                self._c64 @ self._c64.T, variant="rowsum", xp=np
+                self._c64 @ self._c64.T, variant=self.variant, xp=np
             )
         return self._scores_cache
 
