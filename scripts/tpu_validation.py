@@ -25,6 +25,9 @@ def main() -> int:
 
     dev = jax.devices()[0]
     print(f"device: {dev} (platform {dev.platform})", flush=True)
+    # off-TPU the Pallas kernels run in interpret mode (same numerics,
+    # slower) — the direct kernel calls below thread this through
+    interp = dev.platform != "tpu"
 
     from distributed_pathsim_tpu.backends.base import create_backend
     from distributed_pathsim_tpu.data.synthetic import synthetic_hin
@@ -67,11 +70,13 @@ def main() -> int:
     oracle_apa = create_backend("numpy", hin, mp_apa)
     c = jnp.asarray(hin.block("author_of").to_dense(np.float32))
     d = jnp.asarray(np.asarray(oracle_apa.global_walks(), dtype=np.float32))
-    got_kt = np.asarray(pk.fused_scores_ktiled(c, d), dtype=np.float64)
+    got_kt = np.asarray(
+        pk.fused_scores_ktiled(c, d, interpret=interp), dtype=np.float64
+    )
     err = np.max(np.abs(got_kt - oracle_apa.all_pairs_scores()))
     check("ktiled scores vs oracle", err <= 1e-5, f"max|Δ|={err:.2e}")
 
-    v_kt, i_kt = pk.fused_topk_ktiled(c, d, k=5)
+    v_kt, i_kt = pk.fused_topk_ktiled(c, d, k=5, interpret=interp)
     sc = oracle_apa.all_pairs_scores()
     np.fill_diagonal(sc, -np.inf)
     expect = np.sort(sc, axis=1)[:, ::-1][:, :5]
@@ -89,7 +94,9 @@ def main() -> int:
     cp_np = rng_p.integers(0, 1000, (1024, 384)).astype(np.float32)
     cp = jnp.asarray(cp_np)
     dp = jnp.maximum(cp.sum(axis=1), 1.0)
-    got_p = np.asarray(pk.fused_scores(cp, dp), dtype=np.float64)
+    got_p = np.asarray(
+        pk.fused_scores(cp, dp, interpret=interp), dtype=np.float64
+    )
     c64 = cp_np.astype(np.float64)
     d64 = np.maximum(c64.sum(axis=1), 1.0)
     m64 = c64 @ c64.T
@@ -109,8 +116,8 @@ def main() -> int:
     rng = np.random.default_rng(11)
     c2 = jnp.asarray(rng.integers(0, 3, (2304, 64)).astype(np.float32))
     d2 = jnp.maximum(c2.sum(axis=1), 1.0)
-    v_tp, i_tp = pk.fused_topk_twopass(c2, d2, k=10)
-    v_sp, i_sp = pk.fused_topk(c2, d2, k=10)
+    v_tp, i_tp = pk.fused_topk_twopass(c2, d2, k=10, interpret=interp)
+    v_sp, i_sp = pk.fused_topk(c2, d2, k=10, interpret=interp)
     check(
         "twopass topk multi-stripe vs single-pass",
         bool(np.array_equal(np.asarray(v_tp), np.asarray(v_sp)))
@@ -133,7 +140,7 @@ def main() -> int:
         jnp.asarray(cr_np[i0 : i0 + tile_r]), jnp.asarray(cr_np),
         jnp.asarray(dr_np[i0 : i0 + tile_r], dtype=jnp.float32),
         jnp.asarray(dr_np, dtype=jnp.float32),
-        i0 + jnp.arange(tile_r, dtype=jnp.int32), k=k_r,
+        i0 + jnp.arange(tile_r, dtype=jnp.int32), k=k_r, interpret=interp,
     )
     ok_rect = True
     for r in (0, 255, 511):
@@ -158,7 +165,7 @@ def main() -> int:
         jnp.asarray(cw_np[:512]), jnp.asarray(cw_np),
         jnp.asarray(dw_np[:512], dtype=jnp.float32),
         jnp.asarray(dw_np, dtype=jnp.float32),
-        jnp.arange(512, dtype=jnp.int32), k=10,
+        jnp.arange(512, dtype=jnp.int32), k=10, interpret=interp,
     )
     ok_w = all(
         bool(np.allclose(np.asarray(vw[r], dtype=np.float64),
