@@ -191,11 +191,145 @@ bool decode_entities_strict(const char* s, size_t n, std::string* out,
   return true;
 }
 
+// 202 ranges, derived empirically from this build's
+// expat (scripts/derive tool in r04 commit message)
+constexpr unsigned kNameStartRanges[][2] = {
+    {0xc0, 0xd6}, {0xd8, 0xf6}, {0xf8, 0x131}, {0x134, 0x13e},
+    {0x141, 0x148}, {0x14a, 0x17e}, {0x180, 0x1c3}, {0x1cd, 0x1f0},
+    {0x1f4, 0x1f5}, {0x1fa, 0x217}, {0x250, 0x2a8}, {0x2bb, 0x2c1},
+    {0x386, 0x386}, {0x388, 0x38a}, {0x38c, 0x38c}, {0x38e, 0x3a1},
+    {0x3a3, 0x3ce}, {0x3d0, 0x3d6}, {0x3da, 0x3da}, {0x3dc, 0x3dc},
+    {0x3de, 0x3de}, {0x3e0, 0x3e0}, {0x3e2, 0x3f3}, {0x401, 0x40c},
+    {0x40e, 0x44f}, {0x451, 0x45c}, {0x45e, 0x481}, {0x490, 0x4c4},
+    {0x4c7, 0x4c8}, {0x4cb, 0x4cc}, {0x4d0, 0x4eb}, {0x4ee, 0x4f5},
+    {0x4f8, 0x4f9}, {0x531, 0x556}, {0x559, 0x559}, {0x561, 0x586},
+    {0x5d0, 0x5ea}, {0x5f0, 0x5f2}, {0x621, 0x63a}, {0x641, 0x64a},
+    {0x671, 0x6b7}, {0x6ba, 0x6be}, {0x6c0, 0x6ce}, {0x6d0, 0x6d3},
+    {0x6d5, 0x6d5}, {0x6e5, 0x6e6}, {0x905, 0x939}, {0x93d, 0x93d},
+    {0x958, 0x961}, {0x985, 0x98c}, {0x98f, 0x990}, {0x993, 0x9a8},
+    {0x9aa, 0x9b0}, {0x9b2, 0x9b2}, {0x9b6, 0x9b9}, {0x9dc, 0x9dd},
+    {0x9df, 0x9e1}, {0x9f0, 0x9f1}, {0xa05, 0xa0a}, {0xa0f, 0xa10},
+    {0xa13, 0xa28}, {0xa2a, 0xa30}, {0xa32, 0xa33}, {0xa35, 0xa36},
+    {0xa38, 0xa39}, {0xa59, 0xa5c}, {0xa5e, 0xa5e}, {0xa72, 0xa74},
+    {0xa85, 0xa8b}, {0xa8d, 0xa8d}, {0xa8f, 0xa91}, {0xa93, 0xaa8},
+    {0xaaa, 0xab0}, {0xab2, 0xab3}, {0xab5, 0xab9}, {0xabd, 0xabd},
+    {0xae0, 0xae0}, {0xb05, 0xb0c}, {0xb0f, 0xb10}, {0xb13, 0xb28},
+    {0xb2a, 0xb30}, {0xb32, 0xb33}, {0xb36, 0xb39}, {0xb3d, 0xb3d},
+    {0xb5c, 0xb5d}, {0xb5f, 0xb61}, {0xb85, 0xb8a}, {0xb8e, 0xb90},
+    {0xb92, 0xb95}, {0xb99, 0xb9a}, {0xb9c, 0xb9c}, {0xb9e, 0xb9f},
+    {0xba3, 0xba4}, {0xba8, 0xbaa}, {0xbae, 0xbb5}, {0xbb7, 0xbb9},
+    {0xc05, 0xc0c}, {0xc0e, 0xc10}, {0xc12, 0xc28}, {0xc2a, 0xc33},
+    {0xc35, 0xc39}, {0xc60, 0xc61}, {0xc85, 0xc8c}, {0xc8e, 0xc90},
+    {0xc92, 0xca8}, {0xcaa, 0xcb3}, {0xcb5, 0xcb9}, {0xcde, 0xcde},
+    {0xce0, 0xce1}, {0xd05, 0xd0c}, {0xd0e, 0xd10}, {0xd12, 0xd28},
+    {0xd2a, 0xd39}, {0xd60, 0xd61}, {0xe01, 0xe2e}, {0xe30, 0xe30},
+    {0xe32, 0xe33}, {0xe40, 0xe45}, {0xe81, 0xe82}, {0xe84, 0xe84},
+    {0xe87, 0xe88}, {0xe8a, 0xe8a}, {0xe8d, 0xe8d}, {0xe94, 0xe97},
+    {0xe99, 0xe9f}, {0xea1, 0xea3}, {0xea5, 0xea5}, {0xea7, 0xea7},
+    {0xeaa, 0xeab}, {0xead, 0xeae}, {0xeb0, 0xeb0}, {0xeb2, 0xeb3},
+    {0xebd, 0xebd}, {0xec0, 0xec4}, {0xf40, 0xf47}, {0xf49, 0xf69},
+    {0x10a0, 0x10c5}, {0x10d0, 0x10f6}, {0x1100, 0x1100}, {0x1102, 0x1103},
+    {0x1105, 0x1107}, {0x1109, 0x1109}, {0x110b, 0x110c}, {0x110e, 0x1112},
+    {0x113c, 0x113c}, {0x113e, 0x113e}, {0x1140, 0x1140}, {0x114c, 0x114c},
+    {0x114e, 0x114e}, {0x1150, 0x1150}, {0x1154, 0x1155}, {0x1159, 0x1159},
+    {0x115f, 0x1161}, {0x1163, 0x1163}, {0x1165, 0x1165}, {0x1167, 0x1167},
+    {0x1169, 0x1169}, {0x116d, 0x116e}, {0x1172, 0x1173}, {0x1175, 0x1175},
+    {0x119e, 0x119e}, {0x11a8, 0x11a8}, {0x11ab, 0x11ab}, {0x11ae, 0x11af},
+    {0x11b7, 0x11b8}, {0x11ba, 0x11ba}, {0x11bc, 0x11c2}, {0x11eb, 0x11eb},
+    {0x11f0, 0x11f0}, {0x11f9, 0x11f9}, {0x1e00, 0x1e9b}, {0x1ea0, 0x1ef9},
+    {0x1f00, 0x1f15}, {0x1f18, 0x1f1d}, {0x1f20, 0x1f45}, {0x1f48, 0x1f4d},
+    {0x1f50, 0x1f57}, {0x1f59, 0x1f59}, {0x1f5b, 0x1f5b}, {0x1f5d, 0x1f5d},
+    {0x1f5f, 0x1f7d}, {0x1f80, 0x1fb4}, {0x1fb6, 0x1fbc}, {0x1fbe, 0x1fbe},
+    {0x1fc2, 0x1fc4}, {0x1fc6, 0x1fcc}, {0x1fd0, 0x1fd3}, {0x1fd6, 0x1fdb},
+    {0x1fe0, 0x1fec}, {0x1ff2, 0x1ff4}, {0x1ff6, 0x1ffc}, {0x2126, 0x2126},
+    {0x212a, 0x212b}, {0x212e, 0x212e}, {0x2180, 0x2182}, {0x3007, 0x3007},
+    {0x3021, 0x3029}, {0x3041, 0x3094}, {0x30a1, 0x30fa}, {0x3105, 0x312c},
+    {0x4e00, 0x9fa5}, {0xac00, 0xd7a3},
+};
+// 282 ranges, derived empirically from this build's
+// expat (scripts/derive tool in r04 commit message)
+constexpr unsigned kNameCharRanges[][2] = {
+    {0xb7, 0xb7}, {0xc0, 0xd6}, {0xd8, 0xf6}, {0xf8, 0x131},
+    {0x134, 0x13e}, {0x141, 0x148}, {0x14a, 0x17e}, {0x180, 0x1c3},
+    {0x1cd, 0x1f0}, {0x1f4, 0x1f5}, {0x1fa, 0x217}, {0x250, 0x2a8},
+    {0x2bb, 0x2c1}, {0x2d0, 0x2d1}, {0x300, 0x345}, {0x360, 0x361},
+    {0x386, 0x38a}, {0x38c, 0x38c}, {0x38e, 0x3a1}, {0x3a3, 0x3ce},
+    {0x3d0, 0x3d6}, {0x3da, 0x3da}, {0x3dc, 0x3dc}, {0x3de, 0x3de},
+    {0x3e0, 0x3e0}, {0x3e2, 0x3f3}, {0x401, 0x40c}, {0x40e, 0x44f},
+    {0x451, 0x45c}, {0x45e, 0x481}, {0x483, 0x486}, {0x490, 0x4c4},
+    {0x4c7, 0x4c8}, {0x4cb, 0x4cc}, {0x4d0, 0x4eb}, {0x4ee, 0x4f5},
+    {0x4f8, 0x4f9}, {0x531, 0x556}, {0x559, 0x559}, {0x561, 0x586},
+    {0x591, 0x5a1}, {0x5a3, 0x5b9}, {0x5bb, 0x5bd}, {0x5bf, 0x5bf},
+    {0x5c1, 0x5c2}, {0x5c4, 0x5c4}, {0x5d0, 0x5ea}, {0x5f0, 0x5f2},
+    {0x621, 0x63a}, {0x640, 0x652}, {0x660, 0x669}, {0x670, 0x6b7},
+    {0x6ba, 0x6be}, {0x6c0, 0x6ce}, {0x6d0, 0x6d3}, {0x6d5, 0x6e8},
+    {0x6ea, 0x6ed}, {0x6f0, 0x6f9}, {0x901, 0x903}, {0x905, 0x939},
+    {0x93c, 0x94d}, {0x951, 0x954}, {0x958, 0x963}, {0x966, 0x96f},
+    {0x981, 0x983}, {0x985, 0x98c}, {0x98f, 0x990}, {0x993, 0x9a8},
+    {0x9aa, 0x9b0}, {0x9b2, 0x9b2}, {0x9b6, 0x9b9}, {0x9bc, 0x9bc},
+    {0x9be, 0x9c4}, {0x9c7, 0x9c8}, {0x9cb, 0x9cd}, {0x9d7, 0x9d7},
+    {0x9dc, 0x9dd}, {0x9df, 0x9e3}, {0x9e6, 0x9f1}, {0xa02, 0xa02},
+    {0xa05, 0xa0a}, {0xa0f, 0xa10}, {0xa13, 0xa28}, {0xa2a, 0xa30},
+    {0xa32, 0xa33}, {0xa35, 0xa36}, {0xa38, 0xa39}, {0xa3c, 0xa3c},
+    {0xa3e, 0xa42}, {0xa47, 0xa48}, {0xa4b, 0xa4d}, {0xa59, 0xa5c},
+    {0xa5e, 0xa5e}, {0xa66, 0xa74}, {0xa81, 0xa83}, {0xa85, 0xa8b},
+    {0xa8d, 0xa8d}, {0xa8f, 0xa91}, {0xa93, 0xaa8}, {0xaaa, 0xab0},
+    {0xab2, 0xab3}, {0xab5, 0xab9}, {0xabc, 0xac5}, {0xac7, 0xac9},
+    {0xacb, 0xacd}, {0xae0, 0xae0}, {0xae6, 0xaef}, {0xb01, 0xb03},
+    {0xb05, 0xb0c}, {0xb0f, 0xb10}, {0xb13, 0xb28}, {0xb2a, 0xb30},
+    {0xb32, 0xb33}, {0xb36, 0xb39}, {0xb3c, 0xb43}, {0xb47, 0xb48},
+    {0xb4b, 0xb4d}, {0xb56, 0xb57}, {0xb5c, 0xb5d}, {0xb5f, 0xb61},
+    {0xb66, 0xb6f}, {0xb82, 0xb83}, {0xb85, 0xb8a}, {0xb8e, 0xb90},
+    {0xb92, 0xb95}, {0xb99, 0xb9a}, {0xb9c, 0xb9c}, {0xb9e, 0xb9f},
+    {0xba3, 0xba4}, {0xba8, 0xbaa}, {0xbae, 0xbb5}, {0xbb7, 0xbb9},
+    {0xbbe, 0xbc2}, {0xbc6, 0xbc8}, {0xbca, 0xbcd}, {0xbd7, 0xbd7},
+    {0xbe7, 0xbef}, {0xc01, 0xc03}, {0xc05, 0xc0c}, {0xc0e, 0xc10},
+    {0xc12, 0xc28}, {0xc2a, 0xc33}, {0xc35, 0xc39}, {0xc3e, 0xc44},
+    {0xc46, 0xc48}, {0xc4a, 0xc4d}, {0xc55, 0xc56}, {0xc60, 0xc61},
+    {0xc66, 0xc6f}, {0xc82, 0xc83}, {0xc85, 0xc8c}, {0xc8e, 0xc90},
+    {0xc92, 0xca8}, {0xcaa, 0xcb3}, {0xcb5, 0xcb9}, {0xcbe, 0xcc4},
+    {0xcc6, 0xcc8}, {0xcca, 0xccd}, {0xcd5, 0xcd6}, {0xcde, 0xcde},
+    {0xce0, 0xce1}, {0xce6, 0xcef}, {0xd02, 0xd03}, {0xd05, 0xd0c},
+    {0xd0e, 0xd10}, {0xd12, 0xd28}, {0xd2a, 0xd39}, {0xd3e, 0xd43},
+    {0xd46, 0xd48}, {0xd4a, 0xd4d}, {0xd57, 0xd57}, {0xd60, 0xd61},
+    {0xd66, 0xd6f}, {0xe01, 0xe2e}, {0xe30, 0xe3a}, {0xe40, 0xe4e},
+    {0xe50, 0xe59}, {0xe81, 0xe82}, {0xe84, 0xe84}, {0xe87, 0xe88},
+    {0xe8a, 0xe8a}, {0xe8d, 0xe8d}, {0xe94, 0xe97}, {0xe99, 0xe9f},
+    {0xea1, 0xea3}, {0xea5, 0xea5}, {0xea7, 0xea7}, {0xeaa, 0xeab},
+    {0xead, 0xeae}, {0xeb0, 0xeb9}, {0xebb, 0xebd}, {0xec0, 0xec4},
+    {0xec6, 0xec6}, {0xec8, 0xecd}, {0xed0, 0xed9}, {0xf18, 0xf19},
+    {0xf20, 0xf29}, {0xf35, 0xf35}, {0xf37, 0xf37}, {0xf39, 0xf39},
+    {0xf3e, 0xf47}, {0xf49, 0xf69}, {0xf71, 0xf84}, {0xf86, 0xf8b},
+    {0xf90, 0xf95}, {0xf97, 0xf97}, {0xf99, 0xfad}, {0xfb1, 0xfb7},
+    {0xfb9, 0xfb9}, {0x10a0, 0x10c5}, {0x10d0, 0x10f6}, {0x1100, 0x1100},
+    {0x1102, 0x1103}, {0x1105, 0x1107}, {0x1109, 0x1109}, {0x110b, 0x110c},
+    {0x110e, 0x1112}, {0x113c, 0x113c}, {0x113e, 0x113e}, {0x1140, 0x1140},
+    {0x114c, 0x114c}, {0x114e, 0x114e}, {0x1150, 0x1150}, {0x1154, 0x1155},
+    {0x1159, 0x1159}, {0x115f, 0x1161}, {0x1163, 0x1163}, {0x1165, 0x1165},
+    {0x1167, 0x1167}, {0x1169, 0x1169}, {0x116d, 0x116e}, {0x1172, 0x1173},
+    {0x1175, 0x1175}, {0x119e, 0x119e}, {0x11a8, 0x11a8}, {0x11ab, 0x11ab},
+    {0x11ae, 0x11af}, {0x11b7, 0x11b8}, {0x11ba, 0x11ba}, {0x11bc, 0x11c2},
+    {0x11eb, 0x11eb}, {0x11f0, 0x11f0}, {0x11f9, 0x11f9}, {0x1e00, 0x1e9b},
+    {0x1ea0, 0x1ef9}, {0x1f00, 0x1f15}, {0x1f18, 0x1f1d}, {0x1f20, 0x1f45},
+    {0x1f48, 0x1f4d}, {0x1f50, 0x1f57}, {0x1f59, 0x1f59}, {0x1f5b, 0x1f5b},
+    {0x1f5d, 0x1f5d}, {0x1f5f, 0x1f7d}, {0x1f80, 0x1fb4}, {0x1fb6, 0x1fbc},
+    {0x1fbe, 0x1fbe}, {0x1fc2, 0x1fc4}, {0x1fc6, 0x1fcc}, {0x1fd0, 0x1fd3},
+    {0x1fd6, 0x1fdb}, {0x1fe0, 0x1fec}, {0x1ff2, 0x1ff4}, {0x1ff6, 0x1ffc},
+    {0x20d0, 0x20dc}, {0x20e1, 0x20e1}, {0x2126, 0x2126}, {0x212a, 0x212b},
+    {0x212e, 0x212e}, {0x2180, 0x2182}, {0x3005, 0x3005}, {0x3007, 0x3007},
+    {0x3021, 0x302f}, {0x3031, 0x3035}, {0x3041, 0x3094}, {0x3099, 0x309a},
+    {0x309d, 0x309e}, {0x30a1, 0x30fa}, {0x30fc, 0x30fe}, {0x3105, 0x312c},
+    {0x4e00, 0x9fa5}, {0xac00, 0xd7a3},
+};
+
 // A minimal tag token: name + attributes + open/close/selfclose kind.
 struct Tag {
   std::string name;          // namespace-stripped (semantic dispatch)
   std::string raw_name;      // as written (nesting must match exactly)
   std::vector<Attr> attrs;
+  std::vector<std::string> raw_attr_names;  // for prefix validation
+  std::vector<std::string> declared;        // xmlns:PREFIX on this tag
+  std::vector<std::string> declared_uris;   // URIs of those bindings
   bool closing = false;      // </name>
   bool self_closing = false; // <name ... />
 };
@@ -216,7 +350,13 @@ struct Parser {
   const char* end;
   const char* doc_start;
   std::string error;
-  std::vector<std::string> open_stack;  // raw names of open elements
+  struct OpenElem {
+    std::string raw_name;
+    std::vector<std::string> declared;  // xmlns:PREFIX bindings
+  };
+  std::vector<OpenElem> open_stack;  // open elements, innermost last
+  // prefix → stack of bound URIs (innermost last)
+  std::unordered_map<std::string, std::vector<std::string>> ns_active;
   bool seen_root = false;
   bool seen_doctype = false;
 
@@ -289,8 +429,14 @@ struct Parser {
       // element; a second root (or any tag after the root closed) is
       // junk after the document element.
       if (tag->closing) {
-        if (open_stack.empty() || open_stack.back() != tag->raw_name) {
+        if (open_stack.empty() ||
+            open_stack.back().raw_name != tag->raw_name) {
           return fail("mismatched closing tag");
+        }
+        for (const auto& pre : open_stack.back().declared) {
+          auto it = ns_active.find(pre);
+          it->second.pop_back();
+          if (it->second.empty()) ns_active.erase(it);
         }
         open_stack.pop_back();
       } else {
@@ -298,7 +444,12 @@ struct Parser {
           return fail("junk after document element");
         }
         seen_root = true;
-        if (!tag->self_closing) open_stack.push_back(tag->raw_name);
+        if (!tag->self_closing) {
+          for (size_t i = 0; i < tag->declared.size(); ++i) {
+            ns_active[tag->declared[i]].push_back(tag->declared_uris[i]);
+          }
+          open_stack.push_back({tag->raw_name, tag->declared});
+        }
       }
       return true;
     }
@@ -335,8 +486,8 @@ struct Parser {
     const char* q = s;
     const char* name_start = q;
     while (q < e && is_name_char(*q)) ++q;
-    if (q == name_start ||
-        !is_name_start(static_cast<unsigned char>(*name_start))) {
+    if (!valid_name(name_start, q) ||
+        memchr(name_start, ':', q - name_start)) {
       return fail("malformed PI target");
     }
     std::string target(name_start, q - name_start);
@@ -429,25 +580,169 @@ struct Parser {
   static bool is_space(char c) {
     return c == ' ' || c == '\t' || c == '\n' || c == '\r';
   }
-  // XML NameChar (ASCII range; ≥0x80 allowed through as in
-  // is_name_start). Anything looser lets corrupted names like
-  // "sou&rce" parse as names expat rejects.
+  // Byte-level span scan for names: ASCII NameChars plus any ≥0x80
+  // byte (multi-byte sequences are validated as CODE POINTS by
+  // valid_name below — a 10k-mutant soak found expat rejecting
+  // non-NameChar Unicode (U+00D7, or the 5th-edition-only U+0132)
+  // inside names that a byte-level check waved through).
   static bool is_name_char(char ch) {
     unsigned char c = static_cast<unsigned char>(ch);
     return (c >= 'A' && c <= 'Z') || (c >= 'a' && c <= 'z') ||
            (c >= '0' && c <= '9') || c == '-' || c == '.' || c == '_' ||
            c == ':' || c >= 0x80;
   }
-  // XML NameStartChar, ASCII range (multi-byte UTF-8 leads are allowed
-  // through — the document-level scan guarantees they are valid
-  // sequences, and non-ASCII element names don't occur in GEXF).
-  static bool is_name_start(unsigned char c) {
-    return (c >= 'A' && c <= 'Z') || (c >= 'a' && c <= 'z') || c == '_' ||
-           c == ':' || c >= 0x80;
+  // Name character classes — NOT the XML 1.0 5th-edition ranges: the
+  // Python fallback parses through expat, which enforces the FOURTH
+  // edition (Unicode-2.0-frozen) Appendix-B tables, and parity with
+  // the fallback is the contract (a 10k-mutant soak caught 5th-ed
+  // ranges accepting names like "sou\u05F0rce" that expat rejects).
+  // The tables below are derived EMPIRICALLY from this build's expat:
+  // every BMP code point was probed as <Xx/> (name start) and <aXx/>
+  // (name char); no supplementary-plane code point is accepted.
+  static bool in_ranges(unsigned cp, const unsigned (*r)[2], int n) {
+    int lo = 0, hi = n - 1;
+    while (lo <= hi) {
+      int mid = (lo + hi) / 2;
+      if (cp < r[mid][0]) hi = mid - 1;
+      else if (cp > r[mid][1]) lo = mid + 1;
+      else return true;
+    }
+    return false;
+  }
+  static bool is_name_start_cp(unsigned cp) {
+    if (cp < 0x80) {
+      return cp == ':' || cp == '_' || (cp >= 'A' && cp <= 'Z') ||
+             (cp >= 'a' && cp <= 'z');
+    }
+    return in_ranges(cp, kNameStartRanges,
+                     sizeof(kNameStartRanges) / sizeof(*kNameStartRanges));
+  }
+  static bool is_name_cp(unsigned cp) {
+    if (cp < 0x80) {
+      return is_name_start_cp(cp) || cp == '-' || cp == '.' ||
+             (cp >= '0' && cp <= '9');
+    }
+    return in_ranges(cp, kNameCharRanges,
+                     sizeof(kNameCharRanges) / sizeof(*kNameCharRanges));
+  }
+  // Decode one code point; input is valid UTF-8 (document pre-scan).
+  static unsigned next_cp(const char*& q) {
+    unsigned char c = static_cast<unsigned char>(*q++);
+    if (c < 0x80) return c;
+    int extra = c >= 0xF0 ? 3 : c >= 0xE0 ? 2 : 1;
+    unsigned cp = c & (0x3F >> extra);
+    for (int i = 0; i < extra; ++i) {
+      cp = (cp << 6) | (static_cast<unsigned char>(*q++) & 0x3F);
+    }
+    return cp;
+  }
+  static bool valid_name(const char* s, const char* e) {
+    const char* q = s;
+    bool first = true;
+    while (q < e) {
+      unsigned cp = next_cp(q);
+      if (first ? !is_name_start_cp(cp) : !is_name_cp(cp)) return false;
+      first = false;
+    }
+    return !first;
+  }
+
+  // Namespace validation (the Python fallback parses through expat
+  // WITH namespace processing, so this is part of the parity
+  // contract): unbound prefixes reject; NCName structure (no second
+  // colon, local part starts with a NameStartChar); declarations with
+  // empty URIs or reserved prefixes reject; duplicate attributes are
+  // detected on EXPANDED (uri, local) names. Bindings declared on THIS
+  // tag apply to the whole tag regardless of attribute order.
+  static constexpr const char* kXmlUri =
+      "http://www.w3.org/XML/1998/namespace";
+  static constexpr const char* kXmlnsUri =
+      "http://www.w3.org/2000/xmlns/";
+
+  bool check_prefixes(Tag* tag) {
+    // collect this tag's declarations (with URI validation)
+    for (size_t i = 0; i < tag->raw_attr_names.size(); ++i) {
+      const std::string& raw = tag->raw_attr_names[i];
+      if (raw.compare(0, 6, "xmlns:") == 0) {
+        std::string pre = raw.substr(6);
+        const std::string& uri = tag->attrs[i].value;
+        if (pre.empty() || pre.find(':') != std::string::npos) {
+          return fail("malformed xmlns declaration");
+        }
+        if (uri.empty()) return fail("must not undeclare prefix");
+        if (pre == "xmlns") return fail("reserved prefix (xmlns)");
+        if (pre == "xml" ? uri != kXmlUri
+                         : (uri == kXmlUri || uri == kXmlnsUri)) {
+          return fail("reserved namespace binding");
+        }
+        tag->declared.push_back(pre);
+        tag->declared_uris.push_back(uri);
+      }
+    }
+    // prefix → URI under this tag's scope ("" = unbound)
+    auto resolve = [&](const std::string& pre) -> std::string {
+      if (pre == "xml") return kXmlUri;
+      for (size_t i = tag->declared.size(); i-- > 0;) {
+        if (tag->declared[i] == pre) return tag->declared_uris[i];
+      }
+      auto it = ns_active.find(pre);
+      if (it != ns_active.end()) return it->second.back();
+      return "";
+    };
+    // split + structural NCName checks; returns false on malformed
+    auto split_name = [&](const std::string& raw, std::string* pre,
+                          std::string* local) -> bool {
+      size_t c = raw.find(':');
+      if (c == std::string::npos) {
+        *pre = "";
+        *local = raw;
+        return true;
+      }
+      *pre = raw.substr(0, c);
+      *local = raw.substr(c + 1);
+      if (pre->empty() || local->empty() ||
+          local->find(':') != std::string::npos) {
+        return false;  // ":x", "x:", "a:b:c"
+      }
+      const char* q = local->data();
+      if (!is_name_start_cp(next_cp(q))) return false;  // e.g. "p:9x"
+      return true;
+    };
+    std::string pre, local;
+    if (!split_name(tag->raw_name, &pre, &local) ||
+        pre == "xmlns" || (!pre.empty() && resolve(pre).empty())) {
+      return fail("unbound or malformed namespace prefix");
+    }
+    // expanded-name duplicate detection (raw duplicates were caught
+    // inline during attribute parsing)
+    std::vector<std::pair<std::string, std::string>> seen;
+    for (const auto& raw : tag->raw_attr_names) {
+      if (raw == "xmlns" || raw.compare(0, 6, "xmlns:") == 0) continue;
+      if (!split_name(raw, &pre, &local)) {
+        return fail("unbound or malformed namespace prefix");
+      }
+      std::string uri;
+      if (!pre.empty()) {
+        uri = resolve(pre);
+        if (uri.empty()) {
+          return fail("unbound or malformed namespace prefix");
+        }
+      }
+      for (const auto& sn : seen) {
+        if (sn.first == uri && sn.second == local) {
+          return fail("duplicate attribute");
+        }
+      }
+      seen.emplace_back(std::move(uri), std::move(local));
+    }
+    return true;
   }
 
   bool parse_tag(Tag* tag) {
     tag->attrs.clear();
+    tag->raw_attr_names.clear();
+    tag->declared.clear();
+    tag->declared_uris.clear();
     tag->closing = tag->self_closing = false;
     if (p < end && *p == '/') {
       tag->closing = true;
@@ -455,9 +750,7 @@ struct Parser {
     }
     const char* start = p;
     while (p < end && is_name_char(*p)) ++p;
-    if (p == start || !is_name_start(static_cast<unsigned char>(*start))) {
-      return fail("malformed tag name");
-    }
+    if (!valid_name(start, p)) return fail("malformed tag name");
     tag->raw_name.assign(start, p - start);
     tag->name = local_name(tag->raw_name);
     // attributes
@@ -466,7 +759,7 @@ struct Parser {
       if (p >= end) return fail("unterminated tag");
       if (*p == '>') {
         ++p;
-        return true;
+        return check_prefixes(tag);
       }
       if (*p == '/') {
         if (tag->closing) return fail("malformed closing tag");
@@ -474,17 +767,15 @@ struct Parser {
         if (p < end && *p == '>') {
           ++p;
           tag->self_closing = true;
-          return true;
+          return check_prefixes(tag);
         }
         return fail("stray '/' in tag");
       }
       if (tag->closing) return fail("attribute on closing tag");
       const char* astart = p;
       while (p < end && is_name_char(*p)) ++p;
-      if (p == astart ||
-          !is_name_start(static_cast<unsigned char>(*astart))) {
-        return fail("malformed attribute name");
-      }
+      if (!valid_name(astart, p)) return fail("malformed attribute name");
+      const char* p0 = p;
       std::string aname = local_name(std::string(astart, p - astart));
       while (p < end && is_space(*p)) ++p;
       if (p >= end || *p != '=') return fail("attribute without value");
@@ -507,9 +798,13 @@ struct Parser {
       if (!decode_entities_strict(vstart, vend - vstart, &decoded, &err)) {
         return fail_str(err + " in attribute value");
       }
-      for (const auto& a : tag->attrs) {
-        if (a.name == aname) return fail("duplicate attribute");
+      for (const auto& r : tag->raw_attr_names) {
+        if (r.size() == static_cast<size_t>(p0 - astart) &&
+            memcmp(r.data(), astart, r.size()) == 0) {
+          return fail("duplicate attribute");
+        }
       }
+      tag->raw_attr_names.emplace_back(astart, p0 - astart);
       tag->attrs.push_back({std::move(aname), std::move(decoded)});
     }
     return fail("unterminated tag");

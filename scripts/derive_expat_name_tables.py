@@ -1,0 +1,61 @@
+"""Regenerate the expat name-character tables in native/gexf_fast.cpp.
+
+The native parser's contract is byte-level agreement with the Python
+fallback, which parses through expat WITH namespace processing. expat
+enforces the XML 1.0 FOURTH-edition (Unicode-2.0-frozen) name classes
+— not the 5th-edition ranges — so the C++ tables are derived
+EMPIRICALLY: every BMP code point is probed as a name start (<Xx/>)
+and as a name char (<aXx/>) against this interpreter's expat, and the
+accepted ranges are emitted as C++ arrays. Supplementary planes are
+spot-checked (expat accepts none). Run after an expat upgrade and diff
+the emitted tables against the kName*Ranges arrays in gexf_fast.cpp.
+"""
+
+from __future__ import annotations
+
+import xml.parsers.expat as ex
+
+
+def _ok(doc: str) -> bool:
+    p = ex.ParserCreate()
+    try:
+        p.Parse(doc.encode("utf-8"), True)
+        return True
+    except Exception:
+        return False
+
+
+def _ranges(pred, lo: int, hi: int):
+    out, start = [], None
+    for cp in range(lo, hi + 1):
+        good = not (0xD800 <= cp <= 0xDFFF) and pred(cp)
+        if good and start is None:
+            start = cp
+        elif not good and start is not None:
+            out.append((start, cp - 1))
+            start = None
+    if start is not None:
+        out.append((start, hi))
+    return out
+
+
+def main() -> None:
+    ns = _ranges(lambda cp: _ok(f"<{chr(cp)}x/>"), 0x80, 0xFFFF)
+    nc = _ranges(lambda cp: _ok(f"<a{chr(cp)}x/>"), 0x80, 0xFFFF)
+    supp = [0x10000, 0x103FF, 0x20000, 0xE0000, 0xEFFFF]
+    assert not any(_ok(f"<a{chr(cp)}x/>") for cp in supp), (
+        "expat now accepts supplementary-plane name chars — "
+        "extend the tables"
+    )
+    for name, rows in (("kNameStartRanges", ns), ("kNameCharRanges", nc)):
+        print(f"constexpr unsigned {name}[][2] = {{")
+        for i in range(0, len(rows), 4):
+            chunk = ", ".join(
+                "{%#x, %#x}" % (a, b) for a, b in rows[i:i + 4]
+            )
+            print(f"    {chunk},")
+        print("};")
+
+
+if __name__ == "__main__":
+    main()
