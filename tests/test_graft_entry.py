@@ -7,6 +7,7 @@ must be re-pointed at CPU *before* the first ``jax.devices()`` — and the
 leak into the parent environment after the first backend init consumed it.
 """
 
+import pytest
 import json
 import os
 import pathlib
@@ -79,6 +80,9 @@ def test_fresh_process_unset_flags_stay_unset():
     assert out["has_flags"] is False
 
 
+@pytest.mark.skipif(
+    __import__("jax").device_count() < 8, reason="needs 8 virtual devices"
+)
 def test_initialized_process_does_not_mutate_env():
     # In this pytest process backends are already up (8 virtual CPU
     # devices from conftest); the helper must use the cached device list
