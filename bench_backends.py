@@ -43,6 +43,8 @@ def parse_args(argv=None):
         help="comma-separated backend tiers to measure (default depends "
         "on --platform)",
     )
+    p.add_argument("--out", default=None,
+                   help="also append the JSON lines to this file")
     p.add_argument(
         "--platform",
         default="cpu",
@@ -154,26 +156,27 @@ def main(argv=None) -> None:
         # single-device tiers with the mesh size would misread as a
         # multi-device result.
         n_dev = args.devices if name == "jax-sharded" else 1
-        print(
-            json.dumps(
-                {
-                    "metric": (
-                        f"author_pairs_per_sec_{name}_{scale}_authors_"
-                        f"top{args.top_k}_{platform}{n_dev}dev"
-                    ),
-                    # min-of-reps, same rationale as bench.py: robust to
-                    # external load on a shared box; spread stays visible
-                    "value": pairs / tmin,
-                    "unit": "pairs/sec",
-                    "vs_baseline": None,  # CPU mesh: no honest TPU ratio
-                    "seconds_min": tmin,
-                    "seconds_median": med,
-                    "seconds_max": tmax,
-                    "reps": args.repeats,
-                }
-            ),
-            flush=True,
+        line = json.dumps(
+            {
+                "metric": (
+                    f"author_pairs_per_sec_{name}_{scale}_authors_"
+                    f"top{args.top_k}_{platform}{n_dev}dev"
+                ),
+                # min-of-reps, same rationale as bench.py: robust to
+                # external load on a shared box; spread stays visible
+                "value": pairs / tmin,
+                "unit": "pairs/sec",
+                "vs_baseline": None,  # CPU mesh: no honest TPU ratio
+                "seconds_min": tmin,
+                "seconds_median": med,
+                "seconds_max": tmax,
+                "reps": args.repeats,
+            }
         )
+        print(line, flush=True)
+        if args.out:
+            with open(args.out, "a", encoding="utf-8") as f:
+                f.write(line + "\n")
 
 
 if __name__ == "__main__":

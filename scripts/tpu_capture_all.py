@@ -12,6 +12,7 @@ invoked, stage by stage:
   4. realdata    — product CLI on the dblp_large reconstruction
   5. neural      — scripts/neural_bench.py on TPU (65k shape)
   6. scale       — scripts/scale_config5.py --approx (1M streaming)
+  7. backends    — bench_backends.py --platform tpu (tier comparison)
 
 Rules enforced here (never violated):
   - ONE tunnel client at a time; the orchestrator itself NEVER imports
@@ -65,6 +66,9 @@ def _stages(out_dir: pathlib.Path, gexf: str):
         ("scale", 2700,
          ["scripts/scale_config5.py", "--platform", "tpu", "--approx",
           "--out", str(out_dir / "SCALE_r04_TPU.json")]),
+        ("backends", 2700,
+         ["bench_backends.py", "--platform", "tpu", "--authors", "32768",
+          "--out", str(out_dir / "BENCH_BACKENDS_r04_TPU.json")]),
     ]
 
 
