@@ -327,3 +327,25 @@ def test_symmetric_and_full_checkpoints_do_not_mix(dblp_small_hin, tmp_path):
     b.topk_scores(k=3, checkpoint_dir=ck, symmetric=True)
     with pytest.raises(ValueError, match="format"):
         b.topk_scores(k=3, checkpoint_dir=ck, symmetric=False)
+
+
+def test_checkpoint_compute_path_is_identity(dblp_small_hin, tmp_path):
+    """A checkpoint written under one compute path (forced rect kernel)
+    must refuse to resume under another (jnp fold) — the paths' f32
+    rounding and tie-breaks can differ per row tile (ADVICE r03)."""
+    import pytest
+
+    from distributed_pathsim_tpu.backends.base import create_backend
+    from distributed_pathsim_tpu.ops.metapath import compile_metapath
+
+    mp = compile_metapath("APVPA", dblp_small_hin.schema)
+    ck = str(tmp_path / "ck")
+    b1 = create_backend(
+        "jax-sparse", dblp_small_hin, mp, tile_rows=256, rect_kernel=True
+    )
+    b1.topk_scores(k=3, checkpoint_dir=ck)
+    b2 = create_backend(
+        "jax-sparse", dblp_small_hin, mp, tile_rows=256, rect_kernel=False
+    )
+    with pytest.raises(ValueError):
+        b2.topk_scores(k=3, checkpoint_dir=ck)
