@@ -13,6 +13,7 @@ invoked, stage by stage:
   5. neural      — scripts/neural_bench.py on TPU (65k shape)
   6. scale       — scripts/scale_config5.py --approx (1M streaming)
   7. backends    — bench_backends.py --platform tpu (tier comparison)
+  8. cliff       — scripts/dense_cliff_bench.py (131k rect vs fold)
 
 Rules enforced here (never violated):
   - ONE tunnel client at a time; the orchestrator itself NEVER imports
@@ -69,6 +70,9 @@ def _stages(out_dir: pathlib.Path, gexf: str):
         ("backends", 2700,
          ["bench_backends.py", "--platform", "tpu", "--authors", "32768",
           "--out", str(out_dir / "BENCH_BACKENDS_r04_TPU.json")]),
+        ("cliff", 2700,
+         ["scripts/dense_cliff_bench.py", "--platform", "tpu",
+          "--out", str(out_dir / "DENSE_CLIFF_r04_TPU.json")]),
     ]
 
 
