@@ -99,6 +99,25 @@ class EncodedHIN:
         except ValueError:
             return None
 
+    def resolve_source(
+        self,
+        node_type: str,
+        label: str | None = None,
+        node_id: str | None = None,
+    ) -> int:
+        """Label-or-id → dense index, with the canonical not-found
+        messages (shared by the driver and both CLIs — the reference
+        crashes opaquely on an unknown source, SURVEY.md §3.1)."""
+        if label is not None:
+            idx = self.find_index_by_label(node_type, label)
+            if idx is None:
+                raise KeyError(f"no {node_type} labeled {label!r}")
+            return idx
+        idx = self.indices[node_type].index_of.get(node_id)
+        if idx is None:
+            raise KeyError(f"no {node_type} with id {node_id!r}")
+        return idx
+
 
 def encode_hin(graph: HINGraph, schema: HINSchema | None = None) -> EncodedHIN:
     """Encode a host graph into typed index spaces and COO blocks.

@@ -67,16 +67,11 @@ class PathSimDriver:
         # load/encode time into "***Overall done in:".
         logger.overall_start = t0
 
-        if by_label:
-            source_index = self.hin.find_index_by_label(self.node_type, source)
-            if source_index is None:
-                raise KeyError(
-                    f"no {self.node_type} labeled {source!r}"
-                )  # the reference crashes opaquely here (SURVEY.md §3.1)
-        else:
-            source_index = self.index.index_of.get(source)
-            if source_index is None:
-                raise KeyError(f"no {self.node_type} with id {source!r}")
+        source_index = self.hin.resolve_source(
+            self.node_type,
+            label=source if by_label else None,
+            node_id=None if by_label else source,
+        )
 
         # Where the time actually goes (the reference's per-stage clock
         # measures its joins; here the compute collapses to two device

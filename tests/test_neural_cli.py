@@ -1,0 +1,101 @@
+"""Neural-index CLI: train/save/query lifecycle against the goldens."""
+
+import pytest
+
+from distributed_pathsim_tpu.neural_cli import main
+
+
+@pytest.fixture(scope="module")
+def model_path(dblp_small_path, tmp_path_factory):
+    p = str(tmp_path_factory.mktemp("ncli") / "m.npz")
+    rc = main([
+        "train", "--dataset", dblp_small_path, "--out", p,
+        "--steps", "40", "--batch", "512", "--dim", "16",
+        "--hidden", "32",
+    ])
+    assert rc == 0
+    return p
+
+
+def test_query_rerank_reproduces_goldens(model_path, dblp_small_path, capsys):
+    rc = main([
+        "query", "--model", model_path, "--dataset", dblp_small_path,
+        "--source", "Didier Dubois", "--top-k", "2", "--index", "rerank",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    # exact rerank scores = the reference goldens (1/3, 1/7)
+    assert "0.333333  Salem Benferhat" in out
+    assert "0.142857  Henri Prade" in out
+
+
+def test_query_struct_without_dataset(model_path, capsys):
+    """Inference-only restore: bare integer index, no label lookup."""
+    rc = main([
+        "query", "--model", model_path, "--source-id", "0",
+        "--top-k", "3", "--index", "struct",
+    ])
+    assert rc == 0
+    assert "index " in capsys.readouterr().out
+
+
+def test_query_learned_index(model_path, dblp_small_path, capsys):
+    rc = main([
+        "query", "--model", model_path, "--dataset", dblp_small_path,
+        "--source-id", "author_395340", "--top-k", "3",
+        "--index", "learned",
+    ])
+    assert rc == 0
+    assert "learned index" in capsys.readouterr().out
+
+
+def test_unknown_source_clean_error(model_path, dblp_small_path, capsys):
+    rc = main([
+        "query", "--model", model_path, "--dataset", dblp_small_path,
+        "--source", "Nobody Here", "--top-k", "3",
+    ])
+    assert rc == 1
+    err = capsys.readouterr().err
+    # clean single-quoted message, not a str(KeyError) double-quote blob
+    assert "error: no author labeled 'Nobody Here'" in err
+
+
+def test_dataset_checkpoint_mismatch_fails_cleanly(
+    model_path, tmp_path, capsys
+):
+    """Querying with a different graph than the checkpoint's must fail
+    with a named error, not mislabel results."""
+    from distributed_pathsim_tpu.data.synthetic import synthetic_hin, write_gexf
+
+    other = tmp_path / "other.gexf"
+    write_gexf(synthetic_hin(40, 70, 5, seed=1, materialize_ids=True),
+               str(other))
+    rc = main([
+        "query", "--model", model_path, "--dataset", str(other),
+        "--source-id", "author_0", "--top-k", "2",
+    ])
+    assert rc == 1
+    assert "checkpoint was trained on" in capsys.readouterr().err
+
+
+def test_source_label_requires_dataset(model_path, capsys):
+    with pytest.raises(SystemExit):
+        main([
+            "query", "--model", model_path, "--source", "Didier Dubois",
+        ])
+
+
+def test_train_diagonal_variant(dblp_small_path, tmp_path, capsys):
+    p = str(tmp_path / "d.npz")
+    rc = main([
+        "train", "--dataset", dblp_small_path, "--out", p,
+        "--steps", "5", "--batch", "256", "--dim", "8", "--hidden", "16",
+        "--variant", "diagonal",
+    ])
+    assert rc == 0
+    rc = main([
+        "query", "--model", p, "--dataset", dblp_small_path,
+        "--source", "Didier Dubois", "--top-k", "2", "--index", "rerank",
+    ])
+    assert rc == 0
+    assert "diagonal variant" in capsys.readouterr().out
