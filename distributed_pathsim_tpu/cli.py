@@ -427,13 +427,10 @@ def _run_multipath(args) -> int:
     ran = False
     if args.source or args.source_id:
         node_type = scorer.metapaths[0].source_type
-        idx = (
-            hin.find_index_by_label(node_type, args.source)
-            if args.source
-            else hin.indices[node_type].index_of.get(args.source_id)
+        idx = hin.resolve_source(
+            node_type, label=args.source or None,
+            node_id=args.source_id,
         )
-        if idx is None:
-            raise KeyError(f"unknown {node_type} {args.source or args.source_id!r}")
         k = args.top_k or 10
         vals, idxs = scorer.topk_row(idx, k=k, weights=weights)
         labels = hin.indices[node_type].labels
