@@ -646,16 +646,32 @@ _RECT_CAND_MAX_BYTES = 4500 << 20
 # Widest contraction the rect kernel holds un-tiled: the [group·bn,
 # v_pad] column stripe is a 4 MB VMEM block at 512 — comfortable now
 # that the group sweep is a fori_loop (one iteration's temporaries
-# live). Covers every shipped config (64-venue config 5, the 384-venue
-# canonical bench shape); wider factors fall back to the scan fold.
+# live). Covers the narrow configs (64-venue config 5, the 384-venue
+# canonical bench shape); wider factors take the K-tiled rect kernel
+# below (real DBLP has thousands of venues at dblp_large scale —
+# /root/reference/dblp/dblp_small.gexf already carries 85 at 1/123rd
+# scale — so wide V must keep the fused fast path, not fall back).
 _RECT_VMAX = 512
 
 
 def rect_supported(v: int, k: int) -> bool:
-    """The rectangular kernel keeps the whole [group·bn, v_pad] column
-    block in VMEM, so it serves V ≪ N shapes (v ≤ _RECT_VMAX after
-    padding); self-exclusion on the candidate list needs k < _CAND."""
-    return _ceil_to(max(v, 128), 128) <= _RECT_VMAX and k < _CAND
+    """Any factor width stays on the rect fast path: v ≤ _RECT_VMAX
+    runs the un-tiled stripe kernel, wider V the K-tiled variant
+    (contraction tiled at _BK, [bm, stripe] accumulator in VMEM
+    scratch). The only hard gate left is self-exclusion headroom on
+    the candidate list (k < _CAND)."""
+    return k < _CAND
+
+
+def _rect_vpad(v: int) -> int:
+    """Padded contraction width shared by rect_pad_factor and the
+    kernel wrapper (they must agree for the pre-padded fast path):
+    lane-aligned when the un-tiled kernel serves, _BK-aligned when the
+    K-tiled kernel does."""
+    v_pad = _ceil_to(max(v, 128), 128)
+    if v_pad > _RECT_VMAX:
+        v_pad = _ceil_to(v_pad, _BK)
+    return v_pad
 
 
 def rect_pad_factor(c: jax.Array, d: jax.Array):
@@ -666,7 +682,7 @@ def rect_pad_factor(c: jax.Array, d: jax.Array):
     n, v = c.shape
     stripe = _GROUP * _RECT_BN
     n_pad = _ceil_to(max(n, 8), stripe)
-    v_pad = _ceil_to(max(v, 128), 128)
+    v_pad = _rect_vpad(v)
     cc = jnp.zeros((n_pad, v_pad), dtype=jnp.float32).at[:n, :v].set(c)
     dc = jnp.zeros((n_pad,), dtype=jnp.float32).at[:n].set(d)
     return cc, dc
@@ -682,6 +698,69 @@ def rect_fits(n_cols: int, tile_rows: int) -> bool:
     t_pad = _ceil_to(max(tile_rows, 8), _BM)
     cand_bytes = (n_pad // stripe) * t_pad * _HBM_LANE * 8
     return cand_bytes <= _RECT_CAND_MAX_BYTES
+
+
+def _extract_stripe_topk(s, base_col, k: int, lanes: int):
+    """Top-``k+1`` of each row of the masked [bm, stripe] score block,
+    written into lanes 0..k of fresh [bm, lanes] buffers (-inf beyond;
+    global column ids; lowest-column tie-break like ``lax.top_k``).
+
+    Stripe-level extraction is exact for the same reason the per-tile
+    variant is: any row's global top-k element is inside its stripe's
+    top-(k+1) even after one self-pair drop. The rounds run in a
+    ``fori_loop`` — the round temporaries are [bm, stripe] (2 MB at
+    256×2048), and Mosaic stack-allocates every unrolled iteration's
+    copies (the lesson from _topk2_rect_kernel's group sweep), so only
+    one round may be live."""
+    bm, stripe = s.shape
+    lcols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    out_col = jax.lax.broadcasted_iota(jnp.int32, (bm, lanes), 1)
+    big = jnp.int32(2**30)
+
+    def body(t, carry):
+        s, buf_v, buf_c = carry
+        vmax = jnp.max(s, axis=1, keepdims=True)
+        pos = jnp.min(jnp.where(s == vmax, lcols, big), axis=1, keepdims=True)
+        buf_v = jnp.where(out_col == t, vmax, buf_v)
+        buf_c = jnp.where(out_col == t, base_col + pos, buf_c)
+        s = jnp.where(lcols == pos, -jnp.inf, s)
+        return s, buf_v, buf_c
+
+    buf_v = jnp.full((bm, lanes), -jnp.inf, dtype=s.dtype)
+    buf_c = jnp.zeros((bm, lanes), dtype=jnp.int32)
+    _, buf_v, buf_c = jax.lax.fori_loop(
+        0, min(k + 1, lanes), body, (s, buf_v, buf_c)
+    )
+    return buf_v, buf_c
+
+
+def _topk2_rect_kernel_kt(k: int, lanes: int, stripe: int, n_true: int,
+                          n_kb: int, c_i_ref, c_j_ref, d_i_ref, d_j_ref,
+                          vals_ref, cols_ref, acc_ref):
+    """Wide-V rect stripe: the contraction axis rides the innermost
+    grid dim, partial [bm, stripe] products accumulate in VMEM scratch,
+    and the stripe is normalized + extracted once on the last K step.
+    Unlike the un-tiled kernel there is no per-group lane packing: the
+    whole stripe's top-(k+1) lands in one 128-lane block directly, so
+    the candidate buffer has the same no-waste HBM layout."""
+    j = pl.program_id(1)
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    acc_ref[:] += _tile_dot(c_i_ref, c_j_ref)
+
+    @pl.when(kb == n_kb - 1)
+    def _finish():
+        s = _normalize(acc_ref[:], d_i_ref, d_j_ref)
+        base_col = j * stripe
+        cols = base_col + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(cols < n_true, s, -jnp.inf)
+        buf_v, buf_c = _extract_stripe_topk(s, base_col, k, lanes)
+        vals_ref[:] = buf_v
+        cols_ref[:] = buf_c
 
 
 @functools.partial(
@@ -716,7 +795,7 @@ def fused_topk_twopass_rect(
     n, _ = c_cols.shape
     if not rect_supported(v, k):
         raise ValueError(
-            f"fused_topk_twopass_rect requires V<={_RECT_VMAX}, k<{_CAND}"
+            f"fused_topk_twopass_rect requires k<{_CAND}"
         )
     if n_true_cols is None:
         n_true_cols = n
@@ -724,7 +803,7 @@ def fused_topk_twopass_rect(
     stripe = _GROUP * bn
     t_pad = _ceil_to(max(t, 8), _BM)
     n_pad = _ceil_to(max(n, 8), stripe)
-    v_pad = _ceil_to(max(v, 128), 128)
+    v_pad = _rect_vpad(v)
     # Skip the pads when the caller hands kernel-shaped arrays (the
     # streaming backend pre-pads its cached dense C once): re-padding
     # the full column factor here would re-execute an O(N·128) copy on
@@ -754,31 +833,54 @@ def fused_topk_twopass_rect(
 
     n_bi = t_pad // _BM
     n_js = n_pad // stripe
-    vals, cols = pl.pallas_call(
-        functools.partial(
-            _topk2_rect_kernel, k, _CAND, bn, _GROUP, n_true_cols
-        ),
-        grid=(n_bi, n_js),
-        in_specs=[
-            pl.BlockSpec((_BM, v_pad), lambda i, j: (i, 0)),
-            pl.BlockSpec((stripe, v_pad), lambda i, j: (j, 0)),
-            pl.BlockSpec((_BM, 1), lambda i, j: (i, 0)),
-            pl.BlockSpec((stripe, 1), lambda i, j: (j, 0)),
-        ],
-        out_specs=(
-            pl.BlockSpec(
-                (_BM, _GROUP * _CAND), lambda i, j: (j * n_bi + i, 0)
+    out_shape = (
+        jax.ShapeDtypeStruct((n_js * t_pad, _GROUP * _CAND), jnp.float32),
+        jax.ShapeDtypeStruct((n_js * t_pad, _GROUP * _CAND), jnp.int32),
+    )
+    out_specs = (
+        pl.BlockSpec((_BM, _GROUP * _CAND), lambda i, j, *_: (j * n_bi + i, 0)),
+        pl.BlockSpec((_BM, _GROUP * _CAND), lambda i, j, *_: (j * n_bi + i, 0)),
+    )
+    if v_pad <= _RECT_VMAX:
+        vals, cols = pl.pallas_call(
+            functools.partial(
+                _topk2_rect_kernel, k, _CAND, bn, _GROUP, n_true_cols
             ),
-            pl.BlockSpec(
-                (_BM, _GROUP * _CAND), lambda i, j: (j * n_bi + i, 0)
+            grid=(n_bi, n_js),
+            in_specs=[
+                pl.BlockSpec((_BM, v_pad), lambda i, j: (i, 0)),
+                pl.BlockSpec((stripe, v_pad), lambda i, j: (j, 0)),
+                pl.BlockSpec((_BM, 1), lambda i, j: (i, 0)),
+                pl.BlockSpec((stripe, 1), lambda i, j: (j, 0)),
+            ],
+            out_specs=out_specs,
+            out_shape=out_shape,
+            interpret=interpret,
+        )(cr, cc, dr, dc)
+    else:
+        # Wide V: tile the contraction at _BK (innermost grid axis),
+        # accumulate the [bm, stripe] stripe in VMEM scratch (2 MB at
+        # 256×2048 — alongside the [stripe, _BK] column block's 4 MB
+        # and the [bm, _BK] row block, comfortably inside VMEM at any
+        # factor width).
+        n_kb = v_pad // _BK
+        vals, cols = pl.pallas_call(
+            functools.partial(
+                _topk2_rect_kernel_kt, k, _GROUP * _CAND, stripe,
+                n_true_cols, n_kb
             ),
-        ),
-        out_shape=(
-            jax.ShapeDtypeStruct((n_js * t_pad, _GROUP * _CAND), jnp.float32),
-            jax.ShapeDtypeStruct((n_js * t_pad, _GROUP * _CAND), jnp.int32),
-        ),
-        interpret=interpret,
-    )(cr, cc, dr, dc)
+            grid=(n_bi, n_js, n_kb),
+            in_specs=[
+                pl.BlockSpec((_BM, _BK), lambda i, j, kb: (i, kb)),
+                pl.BlockSpec((stripe, _BK), lambda i, j, kb: (j, kb)),
+                pl.BlockSpec((_BM, 1), lambda i, j, kb: (i, 0)),
+                pl.BlockSpec((stripe, 1), lambda i, j, kb: (j, 0)),
+            ],
+            out_specs=out_specs,
+            out_shape=out_shape,
+            scratch_shapes=[pltpu.VMEM((_BM, stripe), jnp.float32)],
+            interpret=interpret,
+        )(cr, cc, dr, dc)
 
     width = n_js * _GROUP * _CAND
     vals = (

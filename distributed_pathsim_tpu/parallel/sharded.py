@@ -155,8 +155,9 @@ def sharded_topk(
         from ..ops import pallas_kernels as pk
 
         # feasibility must be part of the auto-gate: the rect kernel
-        # serves V ≤ 512 (after lane padding) and k < _CAND; shapes it
-        # rejects must fall back to the jnp ring fold, not crash
+        # serves any V (un-tiled stripe kernel to V ≤ 512, the K-tiled
+        # variant beyond) but needs k < _CAND for self-exclusion
+        # headroom; shapes it rejects fall back to the jnp ring fold
         v_out = rest[-1].shape[1] if rest else first.shape[1]
         use_pallas = pk.pallas_supported() and pk.rect_supported(v_out, k)
     # check_vma is disabled on the Pallas ring path: the pallas_call's

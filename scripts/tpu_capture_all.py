@@ -26,7 +26,7 @@ Rules enforced here (never violated):
 
 Usage: python scripts/tpu_capture_all.py [--out-dir artifacts]
          [--stages validation,bench,...] [--keep-going]
-Writes artifacts/capture_log_r04.txt with per-stage outcomes.
+Writes artifacts/capture_log_r05.txt with per-stage outcomes.
 """
 
 from __future__ import annotations
@@ -52,7 +52,7 @@ def _stages(out_dir: pathlib.Path, gexf: str):
          ["bench.py"]),
         ("kernels", 2700,
          ["scripts/kernel_bench.py", "--sweep-tiles",
-          "--out", str(out_dir / "KERNELS_r04.json")]),
+          "--out", str(out_dir / "KERNELS_r05.json")]),
         ("realdata", 1800,
          ["-m", "distributed_pathsim_tpu.cli",
           "--dataset", gexf, "--backend", "jax", "--platform", "tpu",
@@ -63,16 +63,16 @@ def _stages(out_dir: pathlib.Path, gexf: str):
          ["scripts/neural_bench.py", "--platform", "tpu",
           "--steps", "1500", "--batch", "8192", "--dim", "128",
           "--hidden", "256",
-          "--out", str(out_dir / "NEURAL_r04_TPU.json")]),
+          "--out", str(out_dir / "NEURAL_r05_TPU.json")]),
         ("scale", 2700,
          ["scripts/scale_config5.py", "--platform", "tpu", "--approx",
-          "--out", str(out_dir / "SCALE_r04_TPU.json")]),
+          "--out", str(out_dir / "SCALE_r05_TPU.json")]),
         ("backends", 2700,
          ["bench_backends.py", "--platform", "tpu", "--authors", "32768",
-          "--out", str(out_dir / "BENCH_BACKENDS_r04_TPU.json")]),
+          "--out", str(out_dir / "BENCH_BACKENDS_r05_TPU.json")]),
         ("cliff", 2700,
          ["scripts/dense_cliff_bench.py", "--platform", "tpu",
-          "--out", str(out_dir / "DENSE_CLIFF_r04_TPU.json")]),
+          "--out", str(out_dir / "DENSE_CLIFF_r05_TPU.json")]),
     ]
 
 
@@ -183,7 +183,7 @@ def main() -> int:
         )
 
     results = {}
-    with open(out_dir / "capture_log_r04.txt", "a", encoding="utf-8") as log:
+    with open(out_dir / "capture_log_r05.txt", "a", encoding="utf-8") as log:
         log.write(f"# capture sequence started {time.ctime()}\n")
         for name, alarm, argv in _stages(out_dir, args.gexf):
             if wanted and name not in wanted:

@@ -174,6 +174,34 @@ def main() -> int:
     )
     check("rect twopass wide-V (384) vs dense f64", ok_w, "N=4000, k=10")
 
+    # V=2048 routes onto the K-tiled rect kernel (_topk2_rect_kernel_kt:
+    # contraction tiled at 512, [bm, stripe] VMEM accumulator,
+    # stripe-level extraction) — a separate Mosaic compile with its own
+    # VMEM budget that MUST be proven on chip before any wide-V
+    # production run takes it (realistic DBLP venue counts are in the
+    # thousands; pre-r05 these fell back to the fold path).
+    ck_np = (rng2.random((3000, 2048)) < 0.02).astype(np.float32)
+    dk_np = np.maximum(ck_np.sum(axis=1), 1.0)
+    ck64 = ck_np.astype(np.float64)
+    mk = ck64 @ ck64.T
+    denk = dk_np[:, None] + dk_np[None, :]
+    refk = np.where(denk > 0, 2 * mk / np.where(denk > 0, denk, 1), 0.0)
+    np.fill_diagonal(refk, -np.inf)
+    vk, ik = pk.fused_topk_twopass_rect(
+        jnp.asarray(ck_np[:512]), jnp.asarray(ck_np),
+        jnp.asarray(dk_np[:512], dtype=jnp.float32),
+        jnp.asarray(dk_np, dtype=jnp.float32),
+        jnp.arange(512, dtype=jnp.int32), k=10, interpret=interp,
+    )
+    ok_k = all(
+        bool(np.allclose(np.asarray(vk[r], dtype=np.float64),
+                         np.sort(refk[r])[::-1][:10], atol=1e-6))
+        and int(r) not in np.asarray(ik[r])
+        for r in (0, 255, 511)
+    )
+    check("rect twopass K-tiled (V=2048) vs dense f64", ok_k,
+          "N=3000, k=10, 4 K-blocks")
+
     # -- rect kernel inside shard_map (the sharded tier's ring fold) -----
     # A 1-device mesh compiles the real Mosaic kernel under shard_map on
     # chip (virtual-mesh tests only ever run it in interpret mode); the
@@ -199,6 +227,32 @@ def main() -> int:
         bool(np.allclose(np.asarray(rv)[: want_v.shape[0]], want_v,
                          atol=1e-6)),
         "1-device mesh, k=5, dblp_small",
+    )
+
+    # Same shard_map path at V=2048: the K-tiled rect kernel (scratch
+    # accumulator + 3-D grid) inside shard_map with check_vma=False is
+    # a distinct Mosaic compile + discharge combination from both the
+    # narrow shard_map case above and the single-chip kt call — it is
+    # the path every wide-V multi-device production run takes.
+    rng_sm = np.random.default_rng(29)
+    c_sm = (rng_sm.random((2048, 2048)) < 0.02).astype(np.float32)
+    first_w = shard_first_block_rows(c_sm, mesh1)
+    rvw, riw = sharded_topk(
+        first_w, (), mesh=mesh1, k=5, n_true=c_sm.shape[0],
+        use_pallas=True,
+    )
+    c64 = c_sm.astype(np.float64)
+    m64 = c64 @ c64.T
+    d64 = m64.sum(axis=1)
+    den = d64[:, None] + d64[None, :]
+    ref_sm = np.where(den > 0, 2 * m64 / np.where(den > 0, den, 1), 0.0)
+    np.fill_diagonal(ref_sm, -np.inf)
+    expect_sm = np.sort(ref_sm, axis=1)[:, ::-1][:, :5]
+    check(
+        "ring shard_map K-tiled rect kernel (V=2048)",
+        bool(np.allclose(np.asarray(rvw)[: c_sm.shape[0]], expect_sm,
+                         atol=1e-5)),
+        "1-device mesh, k=5, wide V",
     )
 
     if quick:

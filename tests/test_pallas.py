@@ -307,8 +307,10 @@ def test_rect_supported_gates():
     assert pk.rect_supported(64, 10)
     assert pk.rect_supported(384, 10)      # canonical bench width
     assert pk.rect_supported(512, 15)
-    assert not pk.rect_supported(513, 10)  # stripe block exceeds VMEM
+    assert pk.rect_supported(513, 10)      # wide V: K-tiled rect kernel
+    assert pk.rect_supported(4096, 10)     # realistic DBLP venue counts
     assert not pk.rect_supported(64, 16)   # no self-exclusion headroom
+    assert not pk.rect_supported(2048, 16)
 
 
 def test_rect_twopass_wide_contraction():
@@ -337,6 +339,95 @@ def test_rect_twopass_wide_contraction():
         np.testing.assert_allclose(
             np.asarray(vals[r], dtype=np.float64), expect, atol=1e-6
         )
+
+
+def test_rect_twopass_ktiled_wide_v_matches_reference():
+    """V=2048 (realistic venue cardinality at dblp_large scale) takes
+    the K-tiled rect kernel: contraction tiled at 512, [bm, stripe]
+    VMEM accumulator, stripe-level top-(k+1) extraction. Values AND
+    indices vs a dense f64 recomputation, self-pairs excluded."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(41)
+    n, v, tile, k = 3000, 2048, 256, 6
+    c = (rng.random((n, v)) < 0.02).astype(np.float32)
+    d = np.maximum(c.sum(axis=1), 1.0)
+    c64 = c.astype(np.float64)
+    m = c64 @ c64.T
+    den = d[:, None] + d[None, :]
+    ref = np.where(den > 0, 2 * m / np.where(den > 0, den, 1), 0.0)
+    np.fill_diagonal(ref, -np.inf)
+    i0 = 1024
+    vals, idxs = pk.fused_topk_twopass_rect(
+        jnp.asarray(c[i0 : i0 + tile]), jnp.asarray(c),
+        jnp.asarray(d[i0 : i0 + tile], dtype=jnp.float32),
+        jnp.asarray(d, dtype=jnp.float32),
+        i0 + jnp.arange(tile, dtype=jnp.int32), k=k, interpret=True,
+    )
+    for r in (0, 1, 128, 255):
+        expect = np.sort(ref[i0 + r])[::-1][:k]
+        np.testing.assert_allclose(
+            np.asarray(vals[r], dtype=np.float64), expect, atol=1e-6
+        )
+        np.testing.assert_allclose(
+            ref[i0 + r][np.asarray(idxs[r])], expect, atol=1e-6
+        )
+        assert i0 + r not in np.asarray(idxs[r])
+
+
+def test_rect_twopass_ktiled_non_bk_multiple_v():
+    """V=700 pads to 1024 (_BK-aligned): the zero-padded contraction
+    tail must not perturb counts, and the padded tail COLUMNS (rows of
+    c_cols beyond n) must never win a candidate slot."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(43)
+    n, v, tile, k = 2100, 700, 256, 5  # n_pad -> 4096: 1996 pad cols
+    c = (rng.random((n, v)) < 0.05).astype(np.float32)
+    d = np.maximum(c.sum(axis=1), 1.0)
+    c64 = c.astype(np.float64)
+    m = c64 @ c64.T
+    den = d[:, None] + d[None, :]
+    ref = np.where(den > 0, 2 * m / np.where(den > 0, den, 1), 0.0)
+    np.fill_diagonal(ref, -np.inf)
+    vals, idxs = pk.fused_topk_twopass_rect(
+        jnp.asarray(c[:tile]), jnp.asarray(c),
+        jnp.asarray(d[:tile], dtype=jnp.float32),
+        jnp.asarray(d, dtype=jnp.float32),
+        jnp.arange(tile, dtype=jnp.int32), k=k, interpret=True,
+    )
+    assert int(np.asarray(idxs).max()) < n
+    for r in (0, 17, 255):
+        expect = np.sort(ref[r])[::-1][:k]
+        np.testing.assert_allclose(
+            np.asarray(vals[r], dtype=np.float64), expect, atol=1e-6
+        )
+
+
+def test_rect_prepadded_wide_v_matches_unpadded():
+    """rect_pad_factor and the kernel wrapper must agree on the wide-V
+    padded width (_rect_vpad), so the pad-once fast path returns the
+    same winners as raw arrays in the K-tiled regime too."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(47)
+    n, v, tile, k = 2500, 600, 256, 5
+    c = (rng.random((n, v)) < 0.03).astype(np.float32)
+    d = np.maximum(c.sum(axis=1), 1.0).astype(np.float32)
+    cc, dc = pk.rect_pad_factor(jnp.asarray(c), jnp.asarray(d))
+    i0 = 512
+    ids = i0 + jnp.arange(tile, dtype=jnp.int32)
+    v1, i1 = pk.fused_topk_twopass_rect(
+        cc[i0 : i0 + tile], cc, dc[i0 : i0 + tile], dc, ids,
+        k=k, n_true_cols=n, interpret=True,
+    )
+    v2, i2 = pk.fused_topk_twopass_rect(
+        jnp.asarray(c[i0 : i0 + tile]), jnp.asarray(c),
+        jnp.asarray(d[i0 : i0 + tile]), jnp.asarray(d), ids,
+        k=k, interpret=True,
+    )
+    np.testing.assert_array_equal(np.asarray(v1), np.asarray(v2))
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
 
 
 def test_rect_fits_budget():

@@ -215,6 +215,31 @@ def test_ring_pallas_path_diagonal_variant(dblp_small_hin):
     np.testing.assert_array_equal(np.asarray(i_pal), np.asarray(i_jnp))
 
 
+def test_ring_pallas_wide_v_matches_jnp_fold():
+    """Wide V (>512) routes the ring's per-step extraction onto the
+    K-tiled rect kernel — the shard_map + scratch-accumulator + 3-D
+    grid combination every wide-V multi-device run now takes. Values
+    AND indices must match the plain-jnp fold on the 8-device mesh."""
+    import jax.numpy as jnp
+
+    from distributed_pathsim_tpu.parallel.mesh import make_mesh
+    from distributed_pathsim_tpu.parallel.sharded import (
+        shard_first_block_rows,
+        sharded_topk,
+    )
+
+    rng = np.random.default_rng(53)
+    n, v = 1024, 768  # v pads to 1024 -> 2 K-blocks
+    c = (rng.random((n, v)) < 0.03).astype(np.float32)
+    mesh = make_mesh(8)
+    first = shard_first_block_rows(c, mesh)
+    common = dict(mesh=mesh, k=5, n_true=n)
+    v_jnp, i_jnp = sharded_topk(first, (), use_pallas=False, **common)
+    v_pal, i_pal = sharded_topk(first, (), use_pallas=True, **common)
+    np.testing.assert_array_equal(np.asarray(v_pal), np.asarray(v_jnp))
+    np.testing.assert_array_equal(np.asarray(i_pal), np.asarray(i_jnp))
+
+
 def test_sharded_topk_auto_gate_rejects_unsupported_shapes(
     dblp_small_hin, monkeypatch
 ):
