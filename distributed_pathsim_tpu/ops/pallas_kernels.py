@@ -39,6 +39,21 @@ def _ceil_to(x: int, k: int) -> int:
     return ((x + k - 1) // k) * k
 
 
+def _default_scores_tiles(n: int, v: int) -> tuple[int, int]:
+    """fused_scores' default output tile. The on-chip sweep
+    (KERNELS_r05.json, v5e, V=384): (256, 512) reaches 90.3% of the
+    f32 MXU ceiling at N=8k (XLA's GEMM: 86.7%), (512, 1024) 85.3% at
+    N=32k (XLA: 87.0%), vs 74–80% for the old (256, 256) default.
+    Wider tiles hold bigger [tile, v_pad] C blocks, so the pick must
+    honor the same VMEM budget fits_vmem() polices — at wide V the
+    sweep winners would not fit and the floor config stays."""
+    v_pad = _ceil_to(max(v, 128), 128)
+    for bm, bn in ((256, 512),) if n <= 16384 else ((512, 1024), (256, 512)):
+        if (bm + bn) * v_pad * 4 + bm * bn * 4 <= _VMEM_BUDGET_BYTES:
+            return bm, bn
+    return _BM, _BN
+
+
 def _tile_dot(c_i_ref, c_j_ref):
     """One MXU pass of the tile product. HIGHEST precision forces
     full-f32 passes: path counts are integers, and the default bf16
@@ -93,9 +108,12 @@ def fused_scores(c: jax.Array, rowsums: jax.Array, interpret: bool = False,
     (scripts/kernel_bench.py --sweep-tiles; Mosaic VMEM/layout limits
     don't reproduce in interpret mode).
     """
-    bm = _BM if bm is None else bm
-    bn = _BN if bn is None else bn
     n, v = c.shape
+    if bm is None and bn is None:
+        bm, bn = _default_scores_tiles(n, v)
+    else:
+        bm = _BM if bm is None else bm
+        bn = _BN if bn is None else bn
     # pad to a multiple of BOTH tile dims: the grid floor-divides by
     # each, and a pad that only covers the larger one would leave
     # output tiles unwritten for non-dividing (bm, bn) pairs

@@ -18,13 +18,15 @@ script builds a multi-100k-author HIN that
      venue carries ~11k incidences — the "one mega-venue row" shape
      Zipf-synthetic benchmarks underrepresent).
 
-Skew note (vs data/synthetic.py's assumptions): the venue count is
-kept ≤ ~500 so the factor width stays inside the rect kernel's VMEM
-regime (real 2018 DBLP has a few thousand venues; the perf-relevant
-skew — the venue-degree distribution, max colsum ≈ 11.6k vs Zipf
-median ~1e2 — is preserved, the cardinality is compressed). Papers
-are single-author/single-venue: C[a,v] then counts papers directly,
-which is the only structure APVPA observes.
+Skew note (vs data/synthetic.py's assumptions): venue CARDINALITY is
+realistic — a few thousand background venues like 2018 DBLP (the
+pre-r05 default compressed to ~500 to fit the rect kernel's old
+V ≤ 512 limit; the K-tiled rect kernel lifted it, so the factor width
+no longer has to bend to the kernel). The venue-degree skew the
+constraints force (max colsum ≈ 11.6k filler venues vs Zipf median
+~1e2) is preserved as before. Papers are single-author/single-venue:
+C[a,v] then counts papers directly, which is the only structure APVPA
+observes.
 
 Construction per target t (exact integer bookkeeping):
   - pairwise walk m_t: k_t venues shared ONLY by s and t; s holds one
@@ -105,7 +107,7 @@ def main(argv=None) -> dict:
     ap = argparse.ArgumentParser()
     ap.add_argument("--authors", type=int, default=200_000,
                     help="background author count")
-    ap.add_argument("--bg-venues", type=int, default=380)
+    ap.add_argument("--bg-venues", type=int, default=4000)
     ap.add_argument("--mean-papers", type=float, default=2.6)
     ap.add_argument("--out", default="/tmp/dblp_large_reconstructed.gexf")
     ap.add_argument("--log", default=REF_LOG,

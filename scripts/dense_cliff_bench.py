@@ -7,10 +7,17 @@ streaming path. This script times BOTH paths on the same on-device
 (C, rowsums) so the dispatch decision in jax_dense.topk is backed by
 a measurement, not an extrapolation from the 32k fold number.
 
-Timing is wall-clock around block_until_ready with per-rep distinct
-inputs (the ±1e-38 perturbation trick from kernel_bench) — at these
-shapes each call runs hundreds of ms, far above tunnel jitter, so the
-differenced-loop machinery is unnecessary.
+Timing is wall-clock around a SCALAR FETCH of each rep's result with
+per-rep genuinely-distinct inputs. Two traps the r05 capture exposed
+(DENSE_CLIFF_r05_TPU.json recorded a 39 µs "fold" at 131k authors —
+physically impossible):
+  - over the axon relay, ``block_until_ready`` returns before the
+    result is computed; only a device_get (np.asarray of a scalar
+    reduction) proves completion — same reason kernel_bench's
+    differenced loops end in a scalar fetch;
+  - a ``c + 1e-38`` perturbation is absorbed by f32 rounding (counts
+    are ≥ 1), so the "distinct" inputs were bitwise identical — the
+    perturbation must be a real f32 change (.at[0,0].add(i+1)).
 
 Usage: python scripts/dense_cliff_bench.py [--authors 131072]
          [--platform tpu] [--out FILE]   (run as the only TPU client)
@@ -69,14 +76,17 @@ def main(argv=None) -> dict:
     )
 
     def timed(fn):
-        warm = fn(c)
-        jax.block_until_ready(warm)  # compile; result reused for the
-        times = []                   # equality spot-check below
+        warm = fn(c)                   # compile; result reused for the
+        np.asarray(jnp.max(warm[0]))   # equality spot-check below
+        times = []
         for i in range(args.reps):
-            cc = c + (i + 1) * 1e-38  # distinct args: relay result-cache
-            jax.block_until_ready(cc)
+            # a REAL f32 perturbation (1e-38 is absorbed into counts),
+            # materialized before the clock starts
+            cc = c.at[0, 0].add(jnp.float32(i + 1))
+            np.asarray(jnp.max(cc))
             t0 = time.perf_counter()
-            jax.block_until_ready(fn(cc))
+            out = fn(cc)
+            np.asarray(jnp.max(out[0]))  # scalar fetch = proof of work
             times.append(time.perf_counter() - t0)
         return min(times), times, warm
 

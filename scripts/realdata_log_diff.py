@@ -1,0 +1,132 @@
+"""Byte-diff a product-CLI run log against the reference's 2018 log.
+
+The reference's surviving real-data artifact
+(``output/d_pathsim_output_20180417_020445.log``) pins 82 of
+dblp_large's authors exactly (see scripts/dblp_large_reconstruct.py).
+A product run over the reconstruction covers ALL 227k targets; this
+tool extracts the stage blocks for exactly the log-pinned targets and
+compares every surviving content line byte-for-byte (timing lines and
+``---`` separators measure the machine, not the math — excluded, as in
+the r04 artifact).
+
+Usage: python scripts/realdata_log_diff.py RUN_LOG [--ref REF_LOG]
+         [--out ARTIFACT] [--header "..."]
+Exit 0 iff every surviving reference line is reproduced byte-for-byte.
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import re
+import sys
+
+REF_LOG = "/root/reference/output/d_pathsim_output_20180417_020445.log"
+
+
+def reference_blocks(path: str):
+    """(source_line, [(target_id, [content lines])...]) — each block's
+    lines exactly as the 2018 log carries them (the truncated final
+    stage contributes only its Pairwise line)."""
+    lines = open(path, encoding="utf-8").read().splitlines()
+    assert lines[0].startswith("Source author global walk:")
+    source_line = lines[0]
+    blocks: list[tuple[str, list[str]]] = []
+    cur: list[str] = []
+    for ln in lines[1:]:
+        if ln.startswith("***") or ln == "---":
+            continue
+        if ln.startswith("Pairwise authors walk ") and cur:
+            blocks.append((_tid(cur[0]), cur))
+            cur = []
+        cur.append(ln)
+    if cur:
+        blocks.append((_tid(cur[0]), cur))
+    return source_line, blocks
+
+
+def _tid(pairwise_line: str) -> str:
+    m = re.match(r"Pairwise authors walk (\S+):", pairwise_line)
+    assert m, pairwise_line
+    return m.group(1)
+
+
+def run_blocks(path: str):
+    """(source_line, {target_id: [content lines]}) from a product run."""
+    source_line = None
+    blocks: dict[str, list[str]] = {}
+    cur: list[str] = []
+    with open(path, encoding="utf-8") as f:
+        for ln in f:
+            ln = ln.rstrip("\n")
+            if ln.startswith("Source author global walk:"):
+                source_line = ln
+                continue
+            if ln.startswith("***") or ln == "---":
+                continue
+            if ln.startswith("Pairwise authors walk "):
+                if cur:
+                    blocks[_tid(cur[0])] = cur
+                cur = [ln]
+            elif cur:
+                cur.append(ln)
+    if cur:
+        blocks[_tid(cur[0])] = cur
+    return source_line, blocks
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("run_log")
+    ap.add_argument("--ref", default=REF_LOG)
+    ap.add_argument("--out", default=None, help="write the artifact here")
+    ap.add_argument("--header", default="", help="context prepended as #")
+    args = ap.parse_args(argv)
+
+    ref_source, ref_blocks = reference_blocks(args.ref)
+    run_source, run = run_blocks(args.run_log)
+
+    total = matched = 1  # the source-walk line
+    mismatches: list[str] = []
+    if run_source != ref_source:
+        matched = 0
+        mismatches.append(f"source line:\n  ref: {ref_source}\n"
+                          f"  run: {run_source}")
+    for tid, ref_lines in ref_blocks:
+        got = run.get(tid)
+        if got is None:
+            # every line of the block is unreproduced, not just one
+            mismatches.append(f"{tid}: stage missing from run log "
+                              f"({len(ref_lines)} lines unmatched)")
+            total += len(ref_lines)
+            continue
+        for i, ref_ln in enumerate(ref_lines):
+            total += 1
+            if i < len(got) and got[i] == ref_ln:
+                matched += 1
+            else:
+                have = got[i] if i < len(got) else "<absent>"
+                mismatches.append(
+                    f"{tid} line {i}:\n  ref: {ref_ln}\n  run: {have}"
+                )
+
+    ok = matched == total
+    report = [
+        f"reference lines compared: {total}",
+        f"byte-identical: {matched}/{total}",
+        f"targets: {len(ref_blocks)}",
+        "RESULT: ALL MATCH" if ok else "RESULT: MISMATCHES",
+    ] + mismatches
+    text = "\n".join(report)
+    print(text, flush=True)
+    if args.out:
+        hdr = "".join(
+            f"# {ln}\n" for ln in args.header.splitlines() if ln.strip()
+        )
+        pathlib.Path(args.out).write_text(hdr + text + "\n",
+                                          encoding="utf-8")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

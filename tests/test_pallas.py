@@ -430,6 +430,23 @@ def test_rect_prepadded_wide_v_matches_unpadded():
     np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
 
 
+def test_default_scores_tiles_honor_vmem():
+    """The sweep-winning tile defaults (KERNELS_r05) must shrink at
+    factor widths where their C blocks would blow the VMEM budget —
+    fits_vmem() approves V~4000 against the floor config, so the
+    default pick must fall back to it rather than compile a 25 MB
+    block set."""
+    assert pk._default_scores_tiles(8192, 384) == (256, 512)
+    assert pk._default_scores_tiles(32768, 384) == (512, 1024)
+    # wide V: the 32k winner would hold (512+1024)*v_pad*4 > 12 MB
+    assert pk._default_scores_tiles(32768, 2048) == (256, 512)
+    assert pk._default_scores_tiles(8192, 4096) == (256, 256)
+    for n, v in ((8192, 384), (32768, 384), (32768, 2048), (8192, 4096)):
+        bm, bn = pk._default_scores_tiles(n, v)
+        v_pad = pk._ceil_to(max(v, 128), 128)
+        assert (bm + bn) * v_pad * 4 + bm * bn * 4 <= pk._VMEM_BUDGET_BYTES
+
+
 def test_rect_fits_budget():
     # Candidate buffer = n_pad·(t_pad/16) bytes: 4.3 GB at 1M×8192
     # (measured to fit a 16 GB v5e), over budget at 2M×8192 — but a
