@@ -42,22 +42,59 @@ class JaxSparseBackend(PathSimBackend):
         rect_kernel: bool | None = None,
         **options,
     ):
-        """``exact_counts=False`` waives the f32 2^24 exact-integer guard
-        for graphs whose path counts overflow it by construction (the
-        million-author regime): scores are scale-invariant in C, so the
-        cost is only f32 rounding (~√V·2⁻²⁴ relative, inside the ≤1e-5
-        gate), not truncation of the ranking product."""
+        """``exact_counts=True`` (default) delivers EXACT integer counts
+        and bit-exact-vs-f64 scores at any scale. Below the f32 2^24
+        exact-integer range the plain f32 pass is already exact; past it
+        the backend switches to the two-phase exact path automatically:
+        the f32 MXU pass runs as a top-k_cand candidate PREFILTER, and
+        an O(N·k) host pass rescores every candidate in f64 (integers
+        < 2^53: exact), with a per-row error-bound soundness check and
+        a full-row exact fallback where it cannot certify the candidate
+        set (see _exact_topk_rescore). The reference's counts are exact
+        integers (DPathSim_APVPA.py:86-88 — ``int(total_path)``), so
+        "matching" at the million-author scale must not silently round.
+
+        ``exact_counts=False`` waives all of that for pure-ranking runs:
+        scores are scale-invariant in C, so f32 rounding costs only
+        ~√V·2⁻²⁴ relative (inside the ≤1e-5 gate); rankings may swap
+        near-exact ties. Cheaper — no rescore pass."""
         super().__init__(hin, metapath, **options)
         if not metapath.is_symmetric:
             raise ValueError("jax-sparse requires a symmetric metapath")
         self._c = sp.half_chain_coo(hin, metapath)
         self.n = self._c.shape[0]
         self.exact_counts = exact_counts
+        # Overflow detection (same cheap-bound → tight-per-row ladder
+        # the TiledHalfChain guard uses, but the outcome is a MODE, not
+        # a refusal): d_i ≥ M[i,j] ≥ every partial sum (non-negative
+        # data) and C[i,v] ≤ √M[i,i], so max rowsum < 2^24 proves the
+        # whole f32 pipeline exact; past it the rescore phase restores
+        # exactness.
+        self._exact_rescore = False
+        from ..ops import chain as _chain
+
+        if (
+            exact_counts
+            and _chain.effective_device_dtype(dtype) == np.float32
+        ):
+            s = self._c
+            colsum = np.zeros(s.shape[1], dtype=np.float64)
+            np.add.at(colsum, s.cols, s.weights)
+            if float((colsum**2).sum()) >= _chain.F32_EXACT_INT_MAX:
+                rs = np.bincount(
+                    s.rows, weights=s.weights * colsum[s.cols],
+                    minlength=self.n,
+                )
+                if rs.max(initial=0.0) >= _chain.F32_EXACT_INT_MAX:
+                    self._exact_rescore = True
+                    self._host_rowsums = rs
         self.tiled = sp.TiledHalfChain(
             self._c,
             tile_rows=min(tile_rows, max(self.n, 8)),
             dtype=dtype,
-            exact_counts=exact_counts,
+            # in rescore mode the f32 tiles are a prefilter by design;
+            # the tiled guard would refuse what the rescore phase fixes
+            exact_counts=exact_counts and not self._exact_rescore,
         )
         self._dense_c_budget = (
             self._DENSE_C_DEVICE_BUDGET
@@ -86,7 +123,13 @@ class JaxSparseBackend(PathSimBackend):
 
     def global_walks(self) -> np.ndarray:
         if self._rowsums is None:
-            self._rowsums = self.tiled.rowsums()
+            # rescore mode: the device f32 GEMV rounds past 2^24; the
+            # host f64 accumulation (integers < 2^53) is exact and was
+            # already computed by the overflow detector.
+            self._rowsums = (
+                self._host_rowsums if self._exact_rescore
+                else self.tiled.rowsums()
+            )
         return self._rowsums
 
     def commuting_matrix(self) -> np.ndarray:
@@ -95,6 +138,12 @@ class JaxSparseBackend(PathSimBackend):
                 raise MemoryError(
                     f"dense M would be {self.n}x{self.n}; use topk_scores()"
                 )
+            if self._exact_rescore:
+                # counts past 2^24: device f32 tiles would round — do
+                # the (small-n by the gate above) product in host f64
+                c = self._densify_rows_f64(np.arange(self.n))
+                self._m = c @ c.T
+                return self._m
             t = self.tiled
             m = np.zeros((t.n_tiles * t.tile_rows, t.n_tiles * t.tile_rows))
             for i in range(t.n_tiles):
@@ -113,6 +162,8 @@ class JaxSparseBackend(PathSimBackend):
         return self._m
 
     def pairwise_row(self, source_index: int) -> np.ndarray:
+        if self._exact_rescore:
+            return self.pairwise_row_exact(source_index)
         t = self.tiled
         ti, off = divmod(source_index, t.tile_rows)
         src_tile = t.tile(ti)
@@ -173,6 +224,25 @@ class JaxSparseBackend(PathSimBackend):
     def topk_scores(self, k: int = 10, variant: str = "rowsum",
                     checkpoint_dir: str | None = None,
                     symmetric: bool = False):
+        """Streaming per-source top-k (see _topk_scores_f32 for the
+        pass mechanics). In exact-rescore mode (counts past 2^24,
+        exact_counts=True) the f32 pass runs widened to k_cand
+        candidates per row and the exact host phase reduces them to the
+        true top-k — bit-exact vs f64 arithmetic, certified per row."""
+        if not self._exact_rescore:
+            return self._topk_scores_f32(k, variant, checkpoint_dir,
+                                         symmetric)
+        # k+5 margin keeps k=10 inside the rect kernel's k<16 gate
+        # (candidate-set soundness is CERTIFIED per row afterwards, so
+        # the margin size affects fallback cost, never correctness)
+        k_cand = min(max(k + 5, (3 * k) // 2), max(self.n - 1, 1))
+        cv, ci = self._topk_scores_f32(k_cand, variant, checkpoint_dir,
+                                       symmetric)
+        return self._exact_topk_rescore(k, cv, ci, variant)
+
+    def _topk_scores_f32(self, k: int = 10, variant: str = "rowsum",
+                         checkpoint_dir: str | None = None,
+                         symmetric: bool = False):
         """Streaming per-source top-k over row tiles: never materializes
         more than one [tile, tile] score block. Returns (values, indices)
         arrays of shape [N, k].
@@ -481,3 +551,169 @@ class JaxSparseBackend(PathSimBackend):
                         ckpt.drop_unit(prev_key)  # only after the new
                     prev_key = new_key  # snapshot is durable
         return vals, idxs
+
+    # ------------------------------------------------------------------
+    # Exact-counts phase (counts past 2^24): f64 host rescoring of the
+    # f32 pass's candidates. TPU-first split of labor — selection stays
+    # on the MXU in f32; exactness costs one O(N·k_cand·V) host einsum
+    # over integers < 2^53 (exact in f64), not f64 in the hot loop.
+    # ------------------------------------------------------------------
+
+    def _csr_factor(self):
+        """(coalesced row-major COO, indptr) for the rescore helpers.
+        ``self._c`` itself must NOT be assumed sorted or duplicate-free:
+        a single-step half-chain (APA) comes back as the raw adjacency
+        block, unsorted and with duplicate coordinates — ``summed()``
+        canonicalizes (same defense diag_walks uses)."""
+        if getattr(self, "_c_sum", None) is None:
+            self._c_sum = self._c.summed()
+            self._indptr = np.searchsorted(
+                self._c_sum.rows, np.arange(self.n + 1)
+            )
+        return self._c_sum, self._indptr
+
+    def _densify_rows_f64(self, rows: np.ndarray) -> np.ndarray:
+        """Dense f64 [len(rows), V] gather of arbitrary factor rows,
+        fully vectorized (the flat-expansion idiom from coo_matmul)."""
+        s, indptr = self._csr_factor()
+        rows = np.asarray(rows, dtype=np.int64)
+        starts = indptr[rows]
+        counts = indptr[rows + 1] - starts
+        total = int(counts.sum())
+        out = np.zeros((rows.shape[0], self.tiled.v), dtype=np.float64)
+        if total:
+            ridx = np.repeat(np.arange(rows.shape[0]), counts)
+            cum = np.concatenate([[0], np.cumsum(counts)])
+            flat = np.repeat(starts, counts) + (
+                np.arange(total) - np.repeat(cum[:-1], counts)
+            )
+            out[ridx, s.cols[flat]] = s.weights[flat]
+        return out
+
+    def _f32_score_relerr_bound(self) -> float:
+        """Rigorous relative-error bound on a score from the f32 pass:
+        non-negative data makes the GEMM's error ≤ (V+2)u·m (standard
+        forward bound with Σ|terms| = m), plus input casts (C entries
+        and colsums may themselves exceed 2^24), the denominator GEMV,
+        and the final divide — (2V+16)u covers every path, doubled for
+        defense. u = 2^-24."""
+        return (2.0 * self.tiled.v + 16.0) * 2.0**-24 * 2.0
+
+    def _exact_topk_rescore(self, k: int, cand_vals: np.ndarray,
+                            cand_idxs: np.ndarray, variant: str):
+        """Reduce the f32 pass's [N, k_cand] candidates to the exact
+        top-k. Per chunk of rows: gather candidate factor rows dense
+        (f64), one einsum for the pairwise walks, exact normalize,
+        lexicographic (−score, column) selection — the oracle's
+        tie-break. Soundness certificate per row: any non-candidate j
+        has f32 score ≤ the last kept candidate's, so its TRUE score is
+        ≤ that·(1+ε); if the exact k-th candidate beats that bound (or
+        every non-self column is already a candidate, or the last f32
+        score is exactly 0 — zero scores are error-free for integer
+        data), the candidate set provably contains the true top-k.
+        Rows that fail the certificate get a full exact row sweep."""
+        d = np.asarray(
+            self.global_walks() if variant == "rowsum"
+            else self.diag_walks(),
+            dtype=np.float64,
+        )
+        n, v = self.n, self.tiled.v
+        k_cand = cand_idxs.shape[1]
+        kk = min(k, k_cand)
+        eps = self._f32_score_relerr_bound()
+        out_v = np.full((n, k), -np.inf)
+        out_i = np.zeros((n, k), dtype=np.int64)
+        chunk = max(64, int((256 << 20) // max(k_cand * v * 8, 1)))
+        flagged: list[np.ndarray] = []
+        all_cands = n - 1 <= k_cand
+        for i0 in range(0, n, chunk):
+            i1 = min(i0 + chunk, n)
+            rows = np.arange(i0, i1)
+            ci = self._densify_rows_f64(rows)
+            cid = np.asarray(cand_idxs[i0:i1], dtype=np.int64)
+            valid = np.isfinite(cand_vals[i0:i1])
+            safe_id = np.where(valid, cid, 0)
+            cj = self._densify_rows_f64(safe_id.ravel()).reshape(
+                i1 - i0, k_cand, v
+            )
+            m = np.einsum("tv,tcv->tc", ci, cj)
+            den = d[rows][:, None] + d[safe_id]
+            sc = np.where(den > 0, 2.0 * m / np.where(den > 0, den, 1.0),
+                          0.0)
+            sc = np.where(valid, sc, -np.inf)
+            order = np.lexsort((safe_id, -sc), axis=-1)[:, :kk]
+            out_v[i0:i1, :kk] = np.take_along_axis(sc, order, axis=1)
+            out_i[i0:i1, :kk] = np.take_along_axis(safe_id, order, axis=1)
+            if not all_cands:
+                last_f32 = np.asarray(cand_vals[i0:i1, -1],
+                                      dtype=np.float64)
+                kth = out_v[i0:i1, kk - 1]
+                sound = (last_f32 == 0.0) | (kth > last_f32 * (1.0 + eps))
+                if not sound.all():
+                    flagged.append(rows[~sound])
+        # surfaced in scale artifacts: how often the certificate failed
+        self._last_fallback_rows = int(
+            sum(f.shape[0] for f in flagged)
+        )
+        if flagged:
+            self._exact_full_rows(np.concatenate(flagged), d, k,
+                                  out_v, out_i)
+        return out_v, out_i
+
+    def _exact_full_rows(self, rows: np.ndarray, d: np.ndarray, k: int,
+                         out_v: np.ndarray, out_i: np.ndarray) -> None:
+        """Exact f64 scores of ``rows`` against EVERY column, top-k with
+        the (−score, ascending column) tie-break — the uncertifiable-row
+        fallback. Needed exactly when score TIES span the candidate
+        boundary (equal integer counts + equal degrees — common in the
+        low-count tail), because the oracle's ascending-column tie-break
+        then depends on columns the prefilter never kept. Both axes are
+        chunked: the score block never exceeds ~256 MB regardless of how
+        many rows were flagged."""
+        n = self.n
+        col_chunk = max(256, int((64 << 20) // max(self.tiled.v * 8, 1)))
+        row_chunk = max(64, int((256 << 20) // max(col_chunk * 8, 1)))
+        for r0 in range(0, rows.shape[0], row_chunk):
+            rblk = rows[r0 : r0 + row_chunk]
+            ci = self._densify_rows_f64(rblk)
+            di = d[rblk]
+            best_v = np.full((rblk.shape[0], 0), -np.inf)
+            best_c = np.zeros((rblk.shape[0], 0), dtype=np.int64)
+            for j0 in range(0, n, col_chunk):
+                j1 = min(j0 + col_chunk, n)
+                cj = self._densify_rows_f64(np.arange(j0, j1))
+                m = ci @ cj.T
+                den = di[:, None] + d[j0:j1][None, :]
+                sc = np.where(
+                    den > 0, 2.0 * m / np.where(den > 0, den, 1.0), 0.0
+                )
+                cols = np.broadcast_to(np.arange(j0, j1),
+                                       sc.shape).copy()
+                sc = np.where(cols == rblk[:, None], -np.inf, sc)  # self
+                kk = min(k, sc.shape[1])
+                o = np.lexsort((cols, -sc), axis=-1)[:, :kk]
+                merged_v = np.concatenate(
+                    [best_v, np.take_along_axis(sc, o, axis=1)], axis=1
+                )
+                merged_c = np.concatenate(
+                    [best_c, np.take_along_axis(cols, o, axis=1)], axis=1
+                )
+                o = np.lexsort((merged_c, -merged_v), axis=-1)[:, :k]
+                best_v = np.take_along_axis(merged_v, o, axis=1)
+                best_c = np.take_along_axis(merged_c, o, axis=1)
+            kk = best_v.shape[1]
+            out_v[rblk, :kk] = best_v
+            out_i[rblk, :kk] = best_c
+
+    def pairwise_row_exact(self, source_index: int) -> np.ndarray:
+        """M[source, :] with exact f64 host arithmetic — the rescore-
+        mode analog of pairwise_row for the driver's reporting path
+        (the reference prints exact integer counts,
+        DPathSim_APVPA.py:86-88)."""
+        ci = self._densify_rows_f64(np.array([source_index]))[0]
+        out = np.zeros(self.n, dtype=np.float64)
+        chunk = max(256, int((128 << 20) // max(self.tiled.v * 8, 1)))
+        for j0 in range(0, self.n, chunk):
+            j1 = min(j0 + chunk, self.n)
+            out[j0:j1] = self._densify_rows_f64(np.arange(j0, j1)) @ ci
+        return out

@@ -98,8 +98,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--checkpoint-dir",
         default=None,
-        help="resumable ranking state (jax-sparse backend): completed row "
-        "tiles are skipped on restart",
+        help="resumable ranking state (jax-sparse: completed row tiles "
+        "skipped on restart; jax-sharded: mid-ring resume from the last "
+        "checkpointed ring step)",
     )
     p.add_argument(
         "--coordinator-address",

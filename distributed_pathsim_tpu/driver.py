@@ -149,7 +149,8 @@ class PathSimDriver:
             return np.asarray(vals, dtype=np.float64), np.asarray(idxs)
         if checkpoint_dir is not None:
             raise ValueError(
-                "checkpointed ranking requires the jax-sparse backend"
+                "checkpointed ranking requires a streaming backend "
+                "(jax-sparse or jax-sharded)"
             )
         if hasattr(b, "topk") and b.metapath.is_symmetric:
             vals, idxs = b.topk(k=k, mask_self=True, variant=self.variant)

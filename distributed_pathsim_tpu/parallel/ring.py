@@ -113,62 +113,14 @@ def ring_topk_rowblock(
     my = jax.lax.axis_index(axis)
     n_loc = c_local.shape[0]
     perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
-    rows = my * n_loc + jax.lax.broadcasted_iota(
-        jnp.int32, (n_loc, n_loc), 0
-    )
 
     def step(t, carry):
         block, d_block, best_v, best_i = carry
-        owner = (my - t) % n_dev
-        if use_pallas:
-            # Self-pairs exist only while a device holds its OWN block
-            # (owner == my); the kernel drops candidates whose column
-            # equals their row id, and -1 never matches.
-            if mask_self:
-                row_ids = jnp.where(
-                    owner == my,
-                    jnp.arange(n_loc, dtype=jnp.int32),
-                    jnp.full((n_loc,), -1, dtype=jnp.int32),
-                )
-            else:
-                row_ids = jnp.full((n_loc,), -1, dtype=jnp.int32)
-            # n_true_cols=n_loc masks only the kernel's own lane/stripe
-            # padding; RING padding (global col ≥ n_true, all in the
-            # last owner's block) is masked after the global offset.
-            tile_v, tile_loc = pk.fused_topk_twopass_rect(
-                c_local, block, d_local, d_block, row_ids,
-                k=k, n_true_cols=n_loc,
-                interpret=not pk.pallas_supported(),
-            )
-            tile_i = (owner * n_loc).astype(jnp.int32) + tile_loc
-            tile_v = jnp.where(tile_i >= n_true, -jnp.inf, tile_v)
-        else:
-            with jax.default_matmul_precision("highest"):
-                m = jnp.matmul(c_local, block.T)
-            denom = d_local[:, None] + d_block[None, :]
-            s = jnp.where(
-                denom > 0, 2.0 * m / jnp.where(denom > 0, denom, 1.0), 0.0
-            )
-            cols = (
-                (owner * n_loc).astype(jnp.int32)
-                + jax.lax.broadcasted_iota(jnp.int32, (n_loc, n_loc), 1)
-            )
-            s = jnp.where(cols >= n_true, -jnp.inf, s)  # padding columns
-            if mask_self:
-                s = jnp.where(rows == cols, -jnp.inf, s)
-            # Hierarchical prefilter narrows this step's tile to k
-            # candidates (ascending-column tie-breaks, same as the
-            # final sort) BEFORE the lexicographic merge — sorting the
-            # raw [n_loc, n_loc+k] concat each step costs
-            # O(n_loc log n_loc) per row and was the fold's dominant
-            # term at n_loc ≥ 4k (measured 4.3×).
-            tile_v, tile_i = chunked_row_topk(s, cols, k)
-        merged_v = jnp.concatenate([best_v, tile_v], axis=1)
-        merged_i = jnp.concatenate([best_i, tile_i], axis=1)
-        best_v, best_i = _merge_topk_by_col(merged_v, merged_i, k)
-        block = jax.lax.ppermute(block, axis, perm)
-        d_block = jax.lax.ppermute(d_block, axis, perm)
-        return block, d_block, best_v, best_i
+        return ring_topk_step(
+            c_local, d_local, block, d_block, best_v, best_i, t,
+            axis=axis, k=k, n_true=n_true, mask_self=mask_self,
+            use_pallas=use_pallas,
+        )
 
     best_v0 = jax.lax.pcast(
         jnp.full((n_loc, k), -jnp.inf, dtype=c_local.dtype),
@@ -182,3 +134,88 @@ def ring_topk_rowblock(
         0, n_dev, step, (c_local, d_local, best_v0, best_i0)
     )
     return best_v, best_i
+
+
+def ring_topk_step(
+    c_local: jax.Array,
+    d_local: jax.Array,
+    block: jax.Array,
+    d_block: jax.Array,
+    best_v: jax.Array,
+    best_i: jax.Array,
+    t,
+    axis: str,
+    k: int,
+    n_true: int,
+    mask_self: bool = True,
+    use_pallas: bool = False,
+):
+    """ONE ring step, inside shard_map: fold the currently-held peer
+    block's score tile into the running bests, then rotate. Factored
+    out of :func:`ring_topk_rowblock`'s fori_loop so the checkpointable
+    stepwise driver (parallel/sharded.sharded_topk_stepwise) runs the
+    IDENTICAL fold per step — the rotating block itself never needs
+    persisting (after t steps device i holds the block of device
+    (i−t) mod d, a pure block-roll of C reconstructed at resume).
+
+    ``t`` is a traced step index. Returns the next
+    (block, d_block, best_v, best_i)."""
+    from ..ops import pallas_kernels as pk
+
+    n_dev = jax.lax.axis_size(axis)
+    my = jax.lax.axis_index(axis)
+    n_loc = c_local.shape[0]
+    perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+    rows = my * n_loc + jax.lax.broadcasted_iota(
+        jnp.int32, (n_loc, n_loc), 0
+    )
+    owner = (my - t) % n_dev
+    if use_pallas:
+        # Self-pairs exist only while a device holds its OWN block
+        # (owner == my); the kernel drops candidates whose column
+        # equals their row id, and -1 never matches.
+        if mask_self:
+            row_ids = jnp.where(
+                owner == my,
+                jnp.arange(n_loc, dtype=jnp.int32),
+                jnp.full((n_loc,), -1, dtype=jnp.int32),
+            )
+        else:
+            row_ids = jnp.full((n_loc,), -1, dtype=jnp.int32)
+        # n_true_cols=n_loc masks only the kernel's own lane/stripe
+        # padding; RING padding (global col ≥ n_true, all in the
+        # last owner's block) is masked after the global offset.
+        tile_v, tile_loc = pk.fused_topk_twopass_rect(
+            c_local, block, d_local, d_block, row_ids,
+            k=k, n_true_cols=n_loc,
+            interpret=not pk.pallas_supported(),
+        )
+        tile_i = (owner * n_loc).astype(jnp.int32) + tile_loc
+        tile_v = jnp.where(tile_i >= n_true, -jnp.inf, tile_v)
+    else:
+        with jax.default_matmul_precision("highest"):
+            m = jnp.matmul(c_local, block.T)
+        denom = d_local[:, None] + d_block[None, :]
+        s = jnp.where(
+            denom > 0, 2.0 * m / jnp.where(denom > 0, denom, 1.0), 0.0
+        )
+        cols = (
+            (owner * n_loc).astype(jnp.int32)
+            + jax.lax.broadcasted_iota(jnp.int32, (n_loc, n_loc), 1)
+        )
+        s = jnp.where(cols >= n_true, -jnp.inf, s)  # padding columns
+        if mask_self:
+            s = jnp.where(rows == cols, -jnp.inf, s)
+        # Hierarchical prefilter narrows this step's tile to k
+        # candidates (ascending-column tie-breaks, same as the
+        # final sort) BEFORE the lexicographic merge — sorting the
+        # raw [n_loc, n_loc+k] concat each step costs
+        # O(n_loc log n_loc) per row and was the fold's dominant
+        # term at n_loc ≥ 4k (measured 4.3×).
+        tile_v, tile_i = chunked_row_topk(s, cols, k)
+    merged_v = jnp.concatenate([best_v, tile_v], axis=1)
+    merged_i = jnp.concatenate([best_i, tile_i], axis=1)
+    best_v, best_i = _merge_topk_by_col(merged_v, merged_i, k)
+    block = jax.lax.ppermute(block, axis, perm)
+    d_block = jax.lax.ppermute(d_block, axis, perm)
+    return block, d_block, best_v, best_i

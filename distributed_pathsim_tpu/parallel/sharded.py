@@ -190,3 +190,204 @@ def sharded_topk(
         )
 
     return run(first, tuple(rest))
+
+
+@functools.partial(
+    jax.jit, static_argnames=("mesh", "axis", "variant")
+)
+def sharded_ring_state(
+    first: jax.Array,
+    rest: Sequence[jax.Array],
+    mesh: Mesh,
+    axis: str = "dp",
+    variant: str = "rowsum",
+):
+    """The ring's fixed per-device state: the folded local factor block
+    and its denominator rows (one psum for the rowsum variant). Cheap —
+    recomputed on every resume so checkpoints never persist O(N·V)."""
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(axis, None), tuple(P() for _ in rest)),
+        out_specs=(P(axis, None), P(axis)),
+    )
+    def run(first_local, rest_blocks):
+        with jax.default_matmul_precision("highest"):
+            c_local = first_local
+            for b in rest_blocks:
+                c_local = jnp.matmul(c_local, b)
+            if variant == "rowsum":
+                colsum_total = jax.lax.psum(jnp.sum(c_local, axis=0), axis)
+                d_local = jnp.matmul(c_local, colsum_total)
+            elif variant == "diagonal":
+                d_local = jnp.sum(c_local * c_local, axis=1)
+            else:
+                raise ValueError(f"unknown PathSim variant {variant!r}")
+        return c_local, d_local
+
+    return run(first, tuple(rest))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("mesh", "axis", "k", "n_true", "mask_self",
+                     "use_pallas"),
+)
+def sharded_ring_step(
+    c, d, block, d_block, best_v, best_i, t,
+    mesh: Mesh,
+    k: int,
+    n_true: int,
+    axis: str = "dp",
+    mask_self: bool = True,
+    use_pallas: bool = False,
+):
+    """One host-driven ring step over the mesh (ring.ring_topk_step
+    inside shard_map) — the checkpointable unit of the stepwise pass.
+    ``t`` is a traced step index, so all n_dev steps share one compiled
+    program."""
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(
+            P(axis, None), P(axis), P(axis, None), P(axis),
+            P(axis, None), P(axis, None), P(),
+        ),
+        out_specs=(P(axis, None), P(axis), P(axis, None), P(axis, None)),
+        check_vma=not use_pallas,  # same workaround as sharded_topk
+    )
+    def run(c_l, d_l, b_l, db_l, bv_l, bi_l, t_):
+        from .ring import ring_topk_step
+
+        return ring_topk_step(
+            c_l, d_l, b_l, db_l, bv_l, bi_l, t_,
+            axis=axis, k=k, n_true=n_true, mask_self=mask_self,
+            use_pallas=use_pallas,
+        )
+
+    return run(c, d, block, d_block, best_v, best_i,
+               jnp.asarray(t, dtype=jnp.int32))
+
+
+@functools.partial(jax.jit, static_argnames=("shift",))
+def _roll_rows(x: jax.Array, shift: int) -> jax.Array:
+    """Global block-roll that rebuilds the ring's rotating state at
+    resume: after s steps device i holds the block of device (i−s) mod
+    d — exactly roll-by-(s·n_loc) of the row-sharded array (XLA lowers
+    the cross-shard motion to a collective permute)."""
+    return jnp.roll(x, shift, axis=0)
+
+
+def _fetch_global(x) -> np.ndarray:
+    """Full host copy of a (possibly cross-process) sharded array —
+    np.asarray on an array spanning non-addressable devices raises, so
+    multi-host gathers first (same hazard jax_sharded._fetch handles;
+    the checkpointed bests are [N, k], small enough to replicate)."""
+    if jax.process_count() == 1:
+        return np.asarray(x)
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+
+
+def _put_global(arr: np.ndarray, sharding) -> jax.Array:
+    """Place a full host copy (present on every process) as a sharded
+    global array — per-device callback, so it works on multi-process
+    meshes where a plain device_put of the global array would not."""
+    return jax.make_array_from_callback(
+        arr.shape, sharding, lambda idx: arr[idx]
+    )
+
+
+def sharded_topk_stepwise(
+    first: jax.Array,
+    rest: Sequence[jax.Array],
+    mesh: Mesh,
+    k: int,
+    n_true: int,
+    axis: str = "dp",
+    mask_self: bool = True,
+    variant: str = "rowsum",
+    use_pallas: bool | None = None,
+    ckpt=None,
+    every: int = 1,
+):
+    """sharded_topk with mid-ring checkpoint/resume: the ring runs one
+    step per dispatch from the host; every ``every`` steps the [N, k]
+    running bests land in the checkpoint (unit ``ring_bests_after_{t}``)
+    — the mesh-scale analog of the reference's per-stage append-mode
+    crash resilience (SURVEY.md §5). Resume reloads the newest unit,
+    rebuilds C and the rotating block (a block-roll — never persisted),
+    and continues from step t+1. Identical fold → identical results to
+    :func:`sharded_topk` at any kill point.
+
+    ``ckpt``: a utils.checkpoint.CheckpointManager (identity — graph
+    digest, mesh size, compute path — is the CALLER's contract, like
+    the jax-sparse tier's _run_config)."""
+    if use_pallas is None:
+        from ..ops import pallas_kernels as pk
+
+        v_out = rest[-1].shape[1] if rest else first.shape[1]
+        use_pallas = pk.pallas_supported() and pk.rect_supported(v_out, k)
+    n_dev = mesh.shape[axis]
+    c, d = sharded_ring_state(first, tuple(rest), mesh=mesh, axis=axis,
+                              variant=variant)
+    n_pad = c.shape[0]
+    n_loc = n_pad // n_dev
+    sharding2 = jax.NamedSharding(mesh, P(axis, None))
+
+    start = 0
+    prev_key = None
+    if ckpt is not None:
+        prefix = "ring_bests_after_"
+        snaps = [key for key in ckpt.done_keys() if key.startswith(prefix)]
+        if snaps:
+            prev_key = max(snaps, key=lambda s: int(s[len(prefix):]))
+            for stale in snaps:  # crash between save(new)/drop(old)
+                if stale != prev_key:
+                    ckpt.drop_unit(stale)
+            after = int(prev_key[len(prefix):])
+            unit = ckpt.load_unit(prev_key)
+            # the units carry the run's own dtype (an f64/x64 run must
+            # resume in f64 — a float32 cast here would break the
+            # bit-identical-resume contract exactly in the high-count
+            # regime; dtype is part of the caller's checkpoint identity)
+            best_v = _put_global(
+                np.asarray(unit["vals"], dtype=c.dtype), sharding2
+            )
+            best_i = _put_global(
+                np.asarray(unit["idxs"], dtype=np.int32), sharding2
+            )
+            start = after + 1
+    if start == 0:
+        best_v = _put_global(
+            np.full((n_pad, k), -np.inf, dtype=c.dtype), sharding2
+        )
+        best_i = _put_global(
+            np.zeros((n_pad, k), dtype=np.int32), sharding2
+        )
+    if start:
+        block = _roll_rows(c, start * n_loc)
+        d_block = _roll_rows(d, start * n_loc)
+    else:
+        block, d_block = c, d
+
+    for t in range(start, n_dev):
+        block, d_block, best_v, best_i = sharded_ring_step(
+            c, d, block, d_block, best_v, best_i, t,
+            mesh=mesh, k=k, n_true=n_true, axis=axis,
+            mask_self=mask_self, use_pallas=use_pallas,
+        )
+        if ckpt is not None and (t % every == every - 1 or t == n_dev - 1):
+            new_key = f"ring_bests_after_{t}"
+            ckpt.save_unit(
+                new_key,
+                vals=_fetch_global(best_v),
+                idxs=_fetch_global(best_i),
+            )
+            if prev_key is not None and prev_key != new_key:
+                ckpt.drop_unit(prev_key)  # only after the new is durable
+            prev_key = new_key
+    return best_v, best_i

@@ -55,9 +55,11 @@ def parse_args(argv=None):
         help="validate this many random rows against host f64 arithmetic",
     )
     p.add_argument(
-        "--dtype", default=None,
-        help="device dtype; defaults to float64 (exact counts), or "
-        "float32 with --approx",
+        "--dtype", default="float32",
+        help="device dtype; float32 (default) is exact at any scale — "
+        "past 2^24 the backend's two-phase exact path (f32 MXU "
+        "prefilter + certified f64 host rescore) kicks in "
+        "automatically. float64 forces the old x64 device path.",
     )
     p.add_argument(
         "--symmetric", action="store_true",
@@ -74,10 +76,7 @@ def parse_args(argv=None):
         "relative rounding (inside the ≤1e-5 gate), at ~17x the f64 "
         "single-core speed",
     )
-    args = p.parse_args(argv)
-    if args.dtype is None:
-        args.dtype = "float32" if args.approx else "float64"
-    return args
+    return p.parse_args(argv)
 
 
 def _peak_rss_gb() -> float:
@@ -186,6 +185,10 @@ def main(argv=None) -> dict:
         "peak_host_rss_gb": round(_peak_rss_gb(), 3),
         "resumed_row_tiles": resumed,
         "spot_rows_validated": args.spot_rows,
+        "exact_rescore": bool(backend._exact_rescore),
+        "rescore_fallback_rows": int(
+            getattr(backend, "_last_fallback_rows", 0)
+        ),
     }
     line = json.dumps(record)
     print(line, flush=True)

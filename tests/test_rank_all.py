@@ -130,6 +130,78 @@ def test_diagonal_variant_fast_path_is_taken(hin, mp, monkeypatch):
     assert vals.shape == (180, 5)
 
 
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
+def test_ring_checkpoint_kill_and_resume(hin, mp, tmp_path):
+    """VERDICT r04 #5 done-criterion: kill the sharded ring mid-pass at
+    8 virtual devices, resume from the checkpoint, get results
+    identical to an uninterrupted run — and provably skip the
+    already-completed ring steps."""
+    from distributed_pathsim_tpu.parallel import sharded as sh
+
+    ck = str(tmp_path / "ring_ck")
+    b = create_backend("jax-sharded", hin, mp, n_devices=8)
+    want_v, want_i = b.topk(k=5)  # uninterrupted fused reference
+
+    real_step = sh.sharded_ring_step
+    calls = []
+
+    def dying_step(*a, **kw):
+        if len(calls) >= 3:
+            raise KeyboardInterrupt("simulated preemption mid-ring")
+        calls.append(kw.get("t", a[6] if len(a) > 6 else None))
+        return real_step(*a, **kw)
+
+    import unittest.mock as mock
+
+    with mock.patch.object(sh, "sharded_ring_step", dying_step):
+        with pytest.raises(KeyboardInterrupt):
+            b.topk_scores(k=5, checkpoint_dir=ck)
+    assert len(calls) == 3  # steps 0..2 ran and were checkpointed
+
+    # fresh backend (fresh process analog): resume must run ONLY the
+    # remaining 5 steps and produce identical results
+    b2 = create_backend("jax-sharded", hin, mp, n_devices=8)
+    resumed_calls = []
+
+    def counting_step(*a, **kw):
+        resumed_calls.append(1)
+        return real_step(*a, **kw)
+
+    with mock.patch.object(sh, "sharded_ring_step", counting_step):
+        v2, i2 = b2.topk_scores(k=5, checkpoint_dir=ck)
+    assert len(resumed_calls) == 5  # 8 devices − 3 completed steps
+    np.testing.assert_allclose(v2, want_v, atol=1e-6)
+    np.testing.assert_array_equal(i2, want_i)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
+def test_ring_checkpoint_is_mesh_keyed(hin, mp, tmp_path):
+    """Row-block boundaries depend on the device count: a ring
+    checkpoint from one mesh size must refuse to resume on another."""
+    ck = str(tmp_path / "ring_ck")
+    b = create_backend("jax-sharded", hin, mp, n_devices=8)
+    b.topk_scores(k=3, checkpoint_dir=ck)
+    b2 = create_backend("jax-sharded", hin, mp, n_devices=4)
+    with pytest.raises(ValueError):
+        b2.topk_scores(k=3, checkpoint_dir=ck)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
+def test_ring_checkpoint_via_driver_rank_all(hin, mp, tmp_path):
+    """driver.rank_all(checkpoint_dir=...) is accepted on jax-sharded
+    and agrees with the other tiers."""
+    ck = str(tmp_path / "ring_ck")
+    d = PathSimDriver(create_backend("jax-sharded", hin, mp, n_devices=8))
+    v1, i1 = d.rank_all(k=5, checkpoint_dir=ck)
+    v_np, _ = _ranked_vals(hin, mp, "numpy")
+    np.testing.assert_allclose(v1, v_np, atol=1e-6)
+    # rerun resumes from the final unit: byte-identical
+    d2 = PathSimDriver(create_backend("jax-sharded", hin, mp, n_devices=8))
+    v2, i2 = d2.rank_all(k=5, checkpoint_dir=ck)
+    np.testing.assert_array_equal(v1, v2)
+    np.testing.assert_array_equal(i1, i2)
+
+
 def test_diagonal_checkpoint_is_variant_keyed(hin, mp, tmp_path):
     """A checkpoint written under one variant must refuse to resume under
     the other (different denominators → different results)."""

@@ -108,21 +108,16 @@ def test_synthetic_sparse_vs_dense():
     np.testing.assert_array_equal(sparse.global_walks(), dense.global_walks())
 
 
-def test_approx_mode_waives_guard_and_stays_within_gate():
-    """exact_counts=False: a graph whose counts overflow 2^24 (one
-    author with 5000 papers at one venue) must construct in f32 and give
-    scores within the 1e-5 relative gate of exact f64 arithmetic."""
-    import jax.numpy as jnp
-    import pytest
-
-    from distributed_pathsim_tpu.backends.base import create_backend
+def _overflow_hin(counts: np.ndarray):
+    """An HIN whose APVPA half-chain factor equals ``counts`` exactly
+    ([A, V] integer paper multiplicities; each (a, v) pair gets its own
+    papers, single-author/single-venue)."""
     from distributed_pathsim_tpu.data.encode import (
         AdjacencyBlock, EncodedHIN, TypeIndex,
     )
     from distributed_pathsim_tpu.data.schema import HINSchema
-    from distributed_pathsim_tpu.ops.metapath import compile_metapath
 
-    n_p = 5000
+    n_a, n_v = counts.shape
     schema = HINSchema(
         node_types=("author", "paper", "venue"),
         relations={"author_of": ("author", "paper"),
@@ -134,35 +129,198 @@ def test_approx_mode_waives_guard_and_stays_within_gate():
             node_type=t, ids=(), labels=(), index_of={}, size_override=size
         )
 
-    # author 0: n_p papers; author 1: 10 papers — all at one venue
-    a_rows = np.concatenate([np.zeros(n_p, np.int32), np.ones(10, np.int32)])
-    a_cols = np.concatenate(
-        [np.arange(n_p, dtype=np.int32), np.arange(10, dtype=np.int32)]
-    )
-    hin = EncodedHIN(
+    a_i, v_i = np.nonzero(counts)
+    reps = counts[a_i, v_i].astype(np.int64)
+    n_p = int(reps.sum())
+    a_rows = np.repeat(a_i, reps).astype(np.int32)
+    v_cols = np.repeat(v_i, reps).astype(np.int32)
+    papers = np.arange(n_p, dtype=np.int32)
+    return EncodedHIN(
         schema=schema,
-        indices={"author": _idx("author", 2), "paper": _idx("paper", n_p),
-                 "venue": _idx("venue", 1)},
+        indices={"author": _idx("author", n_a), "paper": _idx("paper", n_p),
+                 "venue": _idx("venue", n_v)},
         blocks={
             "author_of": AdjacencyBlock(
-                relationship="author_of", src_type="author", dst_type="paper",
-                rows=a_rows, cols=a_cols, shape=(2, n_p),
+                relationship="author_of", src_type="author",
+                dst_type="paper", rows=a_rows, cols=papers,
+                shape=(n_a, n_p),
             ),
             "submit_at": AdjacencyBlock(
-                relationship="submit_at", src_type="paper", dst_type="venue",
-                rows=np.arange(n_p, dtype=np.int32),
-                cols=np.zeros(n_p, dtype=np.int32),
-                shape=(n_p, 1),
+                relationship="submit_at", src_type="paper",
+                dst_type="venue", rows=papers, cols=v_cols,
+                shape=(n_p, n_v),
+            ),
+        },
+    ), schema
+
+
+def _f64_oracle_topk(c: np.ndarray, k: int):
+    """Exact f64 scores + (−score, ascending column) top-k."""
+    m = c @ c.T
+    d = m.sum(axis=1)
+    den = d[:, None] + d[None, :]
+    s = np.where(den > 0, 2.0 * m / np.where(den > 0, den, 1.0), 0.0)
+    np.fill_diagonal(s, -np.inf)
+    cols = np.broadcast_to(np.arange(c.shape[0]), s.shape)
+    o = np.lexsort((cols, -s), axis=-1)[:, :k]
+    return np.take_along_axis(s, o, axis=1), o, d
+
+
+def test_exact_mode_past_2_24_bit_exact_vs_f64_oracle():
+    """VERDICT r04 #3 done-criterion: a constructed graph whose true
+    counts exceed 2^24 where exact_counts=True (default) runs the
+    two-phase exact path and the scores are BIT-exact vs an f64
+    oracle — construction no longer refuses, and no waiver is needed."""
+    import jax.numpy as jnp
+
+    from distributed_pathsim_tpu.backends.base import create_backend
+    from distributed_pathsim_tpu.ops.metapath import compile_metapath
+
+    rng = np.random.default_rng(61)
+    n_a, n_v = 48, 6
+    counts = np.zeros((n_a, n_v), dtype=np.int64)
+    mask = rng.random((n_a, n_v)) < 0.6
+    counts[mask] = rng.integers(1500, 4000, int(mask.sum()))
+    hin, schema = _overflow_hin(counts)
+    mp = compile_metapath("APVPA", schema)
+
+    b = create_backend("jax-sparse", hin, mp, dtype=jnp.float32,
+                       tile_rows=16)
+    assert b._exact_rescore  # counts overflow: M entries ~ 6*4000^2
+    want_v, want_i, want_d = _f64_oracle_topk(counts.astype(np.float64),
+                                              k=5)
+    got_v, got_i = b.topk_scores(k=5)
+    np.testing.assert_array_equal(got_v, want_v)  # BIT-exact
+    np.testing.assert_array_equal(got_i, want_i)
+    # the reported global walks are exact integers too
+    np.testing.assert_array_equal(b.global_walks(), want_d)
+    # and the single-source reporting path (exact pairwise counts)
+    m_row = b.pairwise_row(3)
+    np.testing.assert_array_equal(
+        m_row, (counts.astype(np.float64) @ counts[3].astype(np.float64))
+    )
+
+
+def test_exact_mode_mass_ties_fall_back_to_full_rows():
+    """Every author identical → every score ties exactly → the per-row
+    soundness certificate cannot hold and the full-row fallback must
+    deliver the oracle's ascending-column tie-break, still bit-exact."""
+    import jax.numpy as jnp
+
+    from distributed_pathsim_tpu.backends.base import create_backend
+    from distributed_pathsim_tpu.ops.metapath import compile_metapath
+
+    n_a = 40
+    counts = np.full((n_a, 1), 5000, dtype=np.int64)  # M[i,j] = 25e6
+    hin, schema = _overflow_hin(counts)
+    mp = compile_metapath("APVPA", schema)
+    b = create_backend("jax-sparse", hin, mp, dtype=jnp.float32,
+                       tile_rows=8)
+    assert b._exact_rescore
+    want_v, want_i, _ = _f64_oracle_topk(counts.astype(np.float64), k=3)
+    got_v, got_i = b.topk_scores(k=3)
+    np.testing.assert_array_equal(got_v, want_v)
+    np.testing.assert_array_equal(got_i, want_i)
+
+
+def test_exact_mode_symmetric_sweep_matches_full():
+    """The rescore phase composes with the symmetric half-sweep."""
+    import jax.numpy as jnp
+
+    from distributed_pathsim_tpu.backends.base import create_backend
+    from distributed_pathsim_tpu.ops.metapath import compile_metapath
+
+    rng = np.random.default_rng(67)
+    counts = rng.integers(0, 3500, (32, 4)).astype(np.int64)
+    hin, schema = _overflow_hin(counts)
+    mp = compile_metapath("APVPA", schema)
+    b = create_backend("jax-sparse", hin, mp, dtype=jnp.float32,
+                       tile_rows=8)
+    assert b._exact_rescore
+    want_v, want_i, _ = _f64_oracle_topk(counts.astype(np.float64), k=4)
+    got_v, got_i = b.topk_scores(k=4, symmetric=True)
+    np.testing.assert_array_equal(got_v, want_v)
+    np.testing.assert_array_equal(got_i, want_i)
+
+
+def test_exact_mode_single_step_halfchain_unsorted_duplicates():
+    """APA's half-chain is ONE block — fold_half_chain returns the raw
+    adjacency COO, unsorted and with duplicate coordinates. The rescore
+    helpers must canonicalize (summed) before building CSR, or the
+    dense gathers silently drop multiplicity / read garbage slices."""
+    import jax.numpy as jnp
+
+    from distributed_pathsim_tpu.data.encode import (
+        AdjacencyBlock, EncodedHIN, TypeIndex,
+    )
+    from distributed_pathsim_tpu.data.schema import HINSchema
+    from distributed_pathsim_tpu.backends.base import create_backend
+    from distributed_pathsim_tpu.ops.metapath import compile_metapath
+
+    rng = np.random.default_rng(71)
+    n_a, n_p, mult = 24, 5, 3000
+    # every (author, paper) pair carries `mult` duplicate edges, emitted
+    # in SHUFFLED order: C[a,p] = mult, counts ~ 5*3000^2 = 4.5e7 > 2^24
+    pairs = [(a, p) for a in range(n_a) for p in range(n_p)]
+    edges = np.array(pairs * mult, dtype=np.int64)
+    perm = rng.permutation(edges.shape[0])
+    edges = edges[perm]
+    schema = HINSchema(
+        node_types=("author", "paper"),
+        relations={"author_of": ("author", "paper")},
+    )
+
+    def _idx(t, size):
+        return TypeIndex(
+            node_type=t, ids=(), labels=(), index_of={}, size_override=size
+        )
+
+    hin = EncodedHIN(
+        schema=schema,
+        indices={"author": _idx("author", n_a), "paper": _idx("paper", n_p)},
+        blocks={
+            "author_of": AdjacencyBlock(
+                relationship="author_of", src_type="author",
+                dst_type="paper",
+                rows=edges[:, 0].astype(np.int32),
+                cols=edges[:, 1].astype(np.int32),
+                shape=(n_a, n_p),
             ),
         },
     )
+    mp = compile_metapath("APA", schema)
+    b = create_backend("jax-sparse", hin, mp, dtype=jnp.float32,
+                       tile_rows=8)
+    assert b._exact_rescore
+    c = np.full((n_a, n_p), float(mult))
+    want_v, want_i, want_d = _f64_oracle_topk(c, k=3)
+    got_v, got_i = b.topk_scores(k=3)
+    np.testing.assert_array_equal(got_v, want_v)
+    np.testing.assert_array_equal(got_i, want_i)
+    np.testing.assert_array_equal(
+        b.pairwise_row(0), c.astype(np.float64) @ c[0]
+    )
+
+
+def test_approx_mode_waives_guard_and_stays_within_gate():
+    """exact_counts=False: a graph whose counts overflow 2^24 (one
+    author with 5000 papers at one venue) must skip the rescore phase
+    entirely and give scores within the 1e-5 relative gate of exact
+    f64 arithmetic."""
+    import jax.numpy as jnp
+
+    from distributed_pathsim_tpu.backends.base import create_backend
+    from distributed_pathsim_tpu.ops.metapath import compile_metapath
+
+    n_p = 5000
+    counts = np.array([[n_p], [10]], dtype=np.int64)
+    hin, schema = _overflow_hin(counts)
     mp = compile_metapath("APVPA", schema)
 
-    with pytest.raises(OverflowError):
-        create_backend("jax-sparse", hin, mp, dtype=jnp.float32)
     b = create_backend(
         "jax-sparse", hin, mp, dtype=jnp.float32, exact_counts=False
     )
+    assert not b._exact_rescore
     vals, idxs = b.topk_scores(k=1)
     # exact arithmetic: C = [[n_p], [10]]; M = C Cᵀ; d = C·(n_p+10)
     c = np.array([[n_p], [10.0]])
