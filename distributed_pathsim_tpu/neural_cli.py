@@ -53,6 +53,11 @@ def cmd_train(args) -> int:
         hin, args.metapath, dim=args.dim, hidden=args.hidden,
         lr=args.lr, seed=args.seed, variant=args.variant,
     )
+    if args.mine:
+        pool_src, pool_cand = model.mine_hard_candidates(
+            args.mine, k=args.mine_k, seed=args.seed
+        )
+        model.set_hard_pool(pool_src, pool_cand)
     losses = model.train(steps=args.steps, batch_size=args.batch,
                          seed=args.seed)
     model.save(args.out)
@@ -97,6 +102,14 @@ def cmd_query(args) -> int:
                 "use --source-id with a bare integer index instead"
             )
         src = int(args.source_id)
+        # Bare indexes bypass the resolver's existence check: reject
+        # out-of-range (raw IndexError otherwise) and negative values
+        # (numpy would silently wrap and rank the wrong node).
+        if not 0 <= src < model.n:
+            raise ValueError(
+                f"--source-id {src} is out of range for this checkpoint "
+                f"(valid bare indexes: 0..{model.n - 1})"
+            )
 
         def show(t):
             return f"index {t}"
@@ -105,9 +118,10 @@ def cmd_query(args) -> int:
         ranked = model.topk_struct(src, k=args.top_k)
     elif args.index == "learned":
         ranked = model.topk(src, k=args.top_k)
-    else:  # rerank: analytic prefilter + exact re-scoring
+    else:  # rerank: embedding prefilter + exact re-scoring
         ranked = model.topk_rerank(
-            src, k=args.top_k, candidates=args.candidates, index="struct"
+            src, k=args.top_k, candidates=args.candidates,
+            index=args.prefilter,
         )
     print(f"Top-{args.top_k} by the {args.index} index "
           f"({model.variant} variant):")
@@ -131,6 +145,12 @@ def main(argv=None) -> int:
     t.add_argument("--hidden", type=int, default=128)
     t.add_argument("--lr", type=float, default=1e-3)
     t.add_argument("--seed", type=int, default=0)
+    t.add_argument("--mine", type=int, default=0, metavar="T",
+                   help="mine exact-teacher hard candidates for T "
+                   "sources and train half of each batch on them "
+                   "(0 = off; lifts top-k resolution on skewed graphs)")
+    t.add_argument("--mine-k", type=int, default=64,
+                   help="mined candidates per source (--mine)")
     t.add_argument("--loader", default="auto",
                    choices=("auto", "python", "native"))
     t.add_argument("--platform", default="auto",
@@ -150,6 +170,10 @@ def main(argv=None) -> int:
                    choices=("struct", "learned", "rerank"))
     q.add_argument("--candidates", type=int, default=100,
                    help="prefilter width for --index rerank")
+    q.add_argument("--prefilter", default="struct",
+                   choices=("struct", "learned"),
+                   help="which embedding index prefilters for --index "
+                   "rerank (learned = O(d) scan from the trained tower)")
     q.add_argument("--loader", default="auto",
                    choices=("auto", "python", "native"))
     q.add_argument("--platform", default="auto",

@@ -239,3 +239,104 @@ def test_diagonal_variant_indexes(hin):
 def test_unknown_variant_rejected(hin):
     with pytest.raises(ValueError, match="unknown PathSim variant"):
         NeuralPathSim(hin, "APVPA", dim=8, hidden=16, variant="bogus")
+
+
+# -- factorized struct queries + exact-teacher mining (r05) ---------------
+
+
+def test_struct_sims_matches_materialized_phi(hin):
+    """The factorized per-source struct query (O(N·V), no [N, m·V] map)
+    must agree with the materialized φ·φ inner product — same sum,
+    different association order, so only f32 round-off apart."""
+    model = NeuralPathSim(hin, "APVPA", dim=8, hidden=16, seed=0)
+    phi = model.struct_embeddings()
+    for i in (0, 7, 113):
+        ref = (phi @ phi[i]).astype(np.float64)
+        got = model.struct_sims(i)
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-9)
+
+
+def test_mined_candidates_are_exact_topk(hin):
+    """mine_hard_candidates must return each source's true exact top-k
+    (up to score ties at the boundary) with the source excluded."""
+    model = NeuralPathSim(hin, "APVPA", dim=8, hidden=16, seed=0)
+    exact = model.exact_scores()
+    k = 8
+    src, cand = model.mine_hard_candidates(16, k=k, seed=3, chunk=5)
+    assert src.shape == (16,) and cand.shape == (16, k)
+    assert len(np.unique(src)) == 16
+    for row, s in enumerate(src):
+        assert int(s) not in set(int(c) for c in cand[row])
+        scores = exact[s].copy()
+        scores[s] = -np.inf
+        kth = np.sort(scores)[::-1][k - 1]
+        # every mined candidate scores at least the k-th best (tie-safe)
+        assert all(scores[c] >= kth for c in cand[row])
+
+
+def test_mining_respects_exclusions(hin):
+    model = NeuralPathSim(hin, "APVPA", dim=8, hidden=16, seed=0)
+    exclude = np.arange(0, 200, 2)  # all even ids
+    src, _ = model.mine_hard_candidates(40, k=4, seed=0, exclude=exclude)
+    assert not np.isin(src, exclude).any()
+
+
+def test_hard_pool_shapes_validated(hin):
+    model = NeuralPathSim(hin, "APVPA", dim=8, hidden=16, seed=0)
+    with pytest.raises(ValueError, match="hard pool"):
+        model.set_hard_pool(np.arange(4), np.zeros((3, 2), int))
+
+
+def test_hard_pool_slates_contain_mined_candidates(hin):
+    """Pool rows must actually draw slate entries from their mined
+    candidate lists (the distillation mechanism, not just plumbing)."""
+    model = NeuralPathSim(hin, "APVPA", dim=8, hidden=16, seed=0)
+    src_pool, cand_pool = model.mine_hard_candidates(8, k=8, seed=1)
+    model.set_hard_pool(src_pool, cand_pool)
+    by_src = {int(s): set(map(int, cand_pool[r]))
+              for r, s in enumerate(src_pool)}
+    rng = np.random.default_rng(0)
+    src, cand, tgt = model.sample_batch(256, rng)
+    s = model.SLATE
+    n_pos = s // 2
+    hard_rows = int(round(len(src) * model.HARD_FRAC))
+    assert hard_rows >= 1
+    n_hard = min(cand_pool.shape[1], s - n_pos - max(1, s // 8))
+    for r in range(hard_rows):
+        assert int(src[r]) in by_src
+        hard_slots = set(map(int, cand[r][n_pos:n_pos + n_hard]))
+        # the overwritten slots are all mined candidates of that source
+        assert hard_slots <= by_src[int(src[r])]
+    # non-pool rows keep uniform sources (statistically: at least one
+    # source outside the 8-element pool among the remaining rows)
+    assert any(int(x) not in by_src for x in src[hard_rows:])
+    assert tgt.shape == (len(src), s)
+
+
+def test_training_with_hard_pool_converges(hin):
+    model = NeuralPathSim(hin, "APVPA", dim=32, hidden=64, lr=3e-3, seed=0)
+    src_pool, cand_pool = model.mine_hard_candidates(64, k=16, seed=2)
+    model.set_hard_pool(src_pool, cand_pool)
+    losses = model.train(steps=60, batch_size=256, seed=0)
+    assert losses[-1] < losses[0] * 0.5
+    model.clear_hard_pool()
+    assert model._hard_src is None
+
+
+def test_hard_pool_rejects_out_of_range_indexes(hin):
+    model = NeuralPathSim(hin, "APVPA", dim=8, hidden=16, seed=0)
+    with pytest.raises(ValueError, match="out of range"):
+        model.set_hard_pool(np.array([5, 1000]), np.zeros((2, 3), int))
+    with pytest.raises(ValueError, match="out of range"):
+        model.set_hard_pool(np.array([5, 6]), np.array([[0, -1, 2]] * 2))
+    with pytest.raises(ValueError, match="integer"):
+        model.set_hard_pool(np.array([5.0, 6.0]), np.zeros((2, 3), int))
+
+
+def test_tiny_batch_keeps_one_hard_row(hin):
+    model = NeuralPathSim(hin, "APVPA", dim=8, hidden=16, seed=0)
+    src_pool, cand_pool = model.mine_hard_candidates(4, k=4, seed=0)
+    model.set_hard_pool(src_pool, cand_pool)
+    rng = np.random.default_rng(0)
+    src, _, _ = model.sample_batch(model.SLATE, rng)  # b == 1
+    assert int(src[0]) in set(map(int, src_pool))

@@ -99,3 +99,45 @@ def test_train_diagonal_variant(dblp_small_path, tmp_path, capsys):
     ])
     assert rc == 0
     assert "diagonal variant" in capsys.readouterr().out
+
+
+def test_bare_source_id_out_of_range_clean_error(model_path, capsys):
+    """ADVICE r04 #1: out-of-range / negative bare indexes must hit the
+    CLI's 'error:' path (ValueError), not a raw IndexError traceback or
+    numpy's silent negative-index wraparound."""
+    rc = main([
+        "query", "--model", model_path, "--source-id", "999999",
+        "--index", "struct",
+    ])
+    assert rc == 1
+    assert "out of range" in capsys.readouterr().err
+    rc = main([
+        "query", "--model", model_path, "--source-id", "-1",
+        "--index", "struct",
+    ])
+    assert rc == 1
+    assert "out of range" in capsys.readouterr().err
+
+
+def test_rerank_prefilter_learned(model_path, dblp_small_path, capsys):
+    """ADVICE r04 #4: rerank mode can prefilter through the learned
+    tower (O(d) scan) instead of always paying the struct index."""
+    rc = main([
+        "query", "--model", model_path, "--dataset", dblp_small_path,
+        "--source", "Didier Dubois", "--top-k", "2", "--index", "rerank",
+        "--prefilter", "learned",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "rerank index" in out
+
+
+def test_train_with_mining(dblp_small_path, tmp_path, capsys):
+    p = str(tmp_path / "mined.npz")
+    rc = main([
+        "train", "--dataset", dblp_small_path, "--out", p,
+        "--steps", "20", "--batch", "256", "--dim", "16",
+        "--hidden", "32", "--mine", "32", "--mine-k", "8",
+    ])
+    assert rc == 0
+    assert "saved to" in capsys.readouterr().out
