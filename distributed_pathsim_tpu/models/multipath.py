@@ -132,20 +132,21 @@ class MultiMetapathScorer:
                 raise ValueError(f"metapath {m.name} is not symmetric")
 
         self.n = hin.type_size(self.metapaths[0].source_type)
-        # Per-path half factors on host (shapes differ per path), padded
-        # to a common contraction width and stacked for the batched einsum.
-        # Sparse half-chain folds: each C_r densifies straight to
-        # [N, V_r] (the dense [N, P] intermediate of a naive chain
-        # product never exists — same discipline as the backends and
-        # the neural trainer).
+        # Per-path half factors stay SPARSE at rest (shapes differ per
+        # path; the dense [N, P] intermediate of a naive chain product
+        # never exists — same discipline as the backends and the neural
+        # trainer). The padded dense stack for the batched all-pairs
+        # einsum is built lazily: a path like APA has contraction width
+        # P (papers), and padding every path to that width is a
+        # [R, N, P] tensor — ~700 GB at the 227k dblp_large
+        # reconstruction — while the streaming single-source path only
+        # ever touches the O(nnz) factors.
         from ..ops import sparse as sp
 
-        cs = [sp.dense_half_chain(hin, m) for m in self.metapaths]
-        vmax = max(c.shape[1] for c in cs)
-        stack = np.zeros((len(cs), self.n, vmax), dtype=np.float32)
-        for r, c in enumerate(cs):
-            stack[r, :, : c.shape[1]] = c
-        self._c_stack = jnp.asarray(stack)
+        self._coo = [
+            sp.half_chain_coo(hin, m).summed() for m in self.metapaths
+        ]
+        self._c_stack_cache: jax.Array | None = None
         self._scores: np.ndarray | None = None
         self._rowsums: np.ndarray | None = None
 
@@ -153,9 +154,39 @@ class MultiMetapathScorer:
     def names(self) -> list[str]:
         return [m.name for m in self.metapaths]
 
+    # Refuse to build the padded dense stack beyond this many f32
+    # entries (default ≈ 8 GiB). The batched all-pairs methods need it;
+    # the streaming single-source path never does.
+    _DENSE_STACK_MAX_ENTRIES = 1 << 31
+
+    def _stack(self) -> jax.Array:
+        """The padded [R, N, Vmax] dense factor stack for the batched
+        einsum paths, built lazily from the sparse factors."""
+        if self._c_stack_cache is None:
+            vmax = max(c.shape[1] for c in self._coo)
+            entries = len(self._coo) * self.n * vmax
+            if entries > self._DENSE_STACK_MAX_ENTRIES:
+                wide = self.names[
+                    int(np.argmax([c.shape[1] for c in self._coo]))
+                ]
+                raise MemoryError(
+                    f"padded factor stack would be {len(self._coo)}x"
+                    f"{self.n}x{vmax} f32 ({4 * entries / 2**30:.0f} GiB; "
+                    f"widest path {wide}); the batched all-pairs methods "
+                    "can't run at this scale — use topk_row (streaming "
+                    "single-source, O(nnz)) instead"
+                )
+            stack = np.zeros(
+                (len(self._coo), self.n, vmax), dtype=np.float32
+            )
+            for r, c in enumerate(self._coo):
+                stack[r, c.rows, c.cols] = c.weights
+            self._c_stack_cache = jnp.asarray(stack)
+        return self._c_stack_cache
+
     def _compute(self):
         if self._scores is None:
-            s, d = _batched_scores(self._c_stack, variant=self.variant)
+            s, d = _batched_scores(self._stack(), variant=self.variant)
             self._scores = np.asarray(s)
             self._rowsums = np.asarray(d, dtype=np.float64)
             chain.check_exact_counts(
@@ -163,14 +194,60 @@ class MultiMetapathScorer:
             )
         return self._scores, self._rowsums
 
+    def _streaming_rowsums(self) -> np.ndarray:
+        """[R, N] per-path denominators straight from the sparse
+        factors — exact f64 integer bookkeeping (bincount sums), no
+        dense stack, no [R, N, N]."""
+        d_all = np.zeros((len(self._coo), self.n))
+        for r, c in enumerate(self._coo):
+            w = c.weights
+            if self.variant == "rowsum":
+                colsum = np.bincount(
+                    c.cols, weights=w, minlength=c.shape[1]
+                )
+                d_all[r] = np.bincount(
+                    c.rows, weights=w * colsum[c.cols], minlength=self.n
+                )
+            else:  # diagonal: Σ_v C[i,v]²
+                d_all[r] = np.bincount(
+                    c.rows, weights=w * w, minlength=self.n
+                )
+        return d_all
+
+    def _row_scores_streaming(self, row: int) -> np.ndarray:
+        """Per-path single-source score rows [R, N] in O(Σ_r nnz_r):
+        sim_r(row, j) = 2·(C_r[row]·C_r[j]) / (d_r[row] + d_r[j]) with
+        the numerator as one sparse gather-multiply-scatter per path.
+        Exact f64 (integer counts sum exactly below 2⁵³) — this is the
+        path the CLI's single-source ensemble takes at scales where the
+        dense stack cannot exist."""
+        d_all = self.global_walks()  # cached [R, N]; exact either way
+        out = np.zeros((len(self._coo), self.n))
+        for r, c in enumerate(self._coo):
+            w = c.weights
+            src = np.zeros(c.shape[1])
+            mask = c.rows == row
+            src[c.cols[mask]] = w[mask]  # coalesced: one entry per col
+            cc = np.bincount(
+                c.rows, weights=w * src[c.cols], minlength=self.n
+            )
+            denom = d_all[r, row] + d_all[r]
+            out[r] = np.where(denom > 0, 2.0 * cc / np.where(
+                denom > 0, denom, 1.0), 0.0)
+        return out
+
     def scores(self) -> np.ndarray:
         """[R, N, N] per-path score tensors."""
         return self._compute()[0]
 
     def global_walks(self) -> np.ndarray:
         """[R, N] per-path denominators (the reference's global walks
-        under "rowsum"; diag(M_r) under "diagonal")."""
-        return self._compute()[1]
+        under "rowsum"; diag(M_r) under "diagonal"). Streams from the
+        sparse factors unless the dense all-pairs cache already paid
+        for itself — the CLI header must not force an [R, N, N]."""
+        if self._rowsums is None:
+            self._rowsums = self._streaming_rowsums()
+        return self._rowsums
 
     def _resolve_weights(self, weights: Sequence[float] | None) -> np.ndarray:
         """Uniform default / float32 cast / shape check — one place, so
@@ -223,7 +300,7 @@ class MultiMetapathScorer:
         mesh = make_mesh(n_devices)
         w = self._resolve_weights(weights)
         n_pad = pad_to_multiple(self.n, mesh.shape["dp"])
-        stack = self._c_stack
+        stack = self._stack()
         if n_pad != self.n:
             stack = jnp.pad(stack, ((0, 0), (0, n_pad - self.n), (0, 0)))
         vals, idxs = _sharded_combined_topk(
@@ -236,8 +313,14 @@ class MultiMetapathScorer:
         )
 
     def topk_row(self, row: int, k: int = 10, weights: Sequence[float] | None = None):
-        """Top-k for ONE source row — ranks only that row."""
-        s = self.combined_scores(weights)[row].copy()
+        """Top-k for ONE source row — ranks only that row, via the
+        streaming O(nnz) path (reuses the dense cache when an all-pairs
+        method already built it)."""
+        if self._scores is not None:
+            s = self.combined_scores(weights)[row].astype(np.float64)
+        else:
+            w = self._resolve_weights(weights).astype(np.float64)
+            s = np.einsum("rn,r->n", self._row_scores_streaming(row), w)
         s[row] = -np.inf
         k = min(k, s.shape[0] - 1)
         part = np.argpartition(-s, k - 1)[:k]

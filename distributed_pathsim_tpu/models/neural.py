@@ -496,6 +496,13 @@ class NeuralPathSim:
         rng = np.random.default_rng(seed)
         n_sources = min(n_sources, len(avail))
         sources = np.sort(rng.choice(avail, size=n_sources, replace=False))
+        # The teacher must actually be exact: counts and row sums are
+        # integers, exact in f32 only below 2²⁴ (and only if the matmul
+        # runs full f32 passes — TPU f32 matmuls default to bf16
+        # passes, whose exact-integer range ends at 256).
+        from ..ops.chain import check_exact_counts
+
+        check_exact_counts(float(self._d.max(initial=0.0)), np.float32)
         c_dev = jnp.asarray(self._c32())
         d_dev = jnp.asarray(self._d.astype(np.float32))
 
@@ -503,7 +510,8 @@ class NeuralPathSim:
         def _chunk_topk(idx):
             cs = jnp.take(c_dev, idx, axis=0)          # [T, V]
             ds = jnp.take(d_dev, idx)                  # [T]
-            cc = cs @ c_dev.T                          # [T, N] on the MXU
+            with jax.default_matmul_precision("highest"):
+                cc = cs @ c_dev.T                      # [T, N] on the MXU
             denom = ds[:, None] + d_dev[None, :]
             sims = jnp.where(denom > 0, 2.0 * cc / denom, 0.0)
             sims = sims.at[jnp.arange(idx.shape[0]), idx].set(-jnp.inf)
@@ -529,8 +537,10 @@ class NeuralPathSim:
         it with slates built from the mined lists (see
         :meth:`sample_batch`). Not persisted by :meth:`save` — mining
         is a cheap deterministic device pass, re-run it after load."""
-        sources = np.asarray(sources)
-        cands = np.asarray(cands)
+        # copies, not views: a caller mutating its buffer after install
+        # would silently bypass the range validation below
+        sources = np.array(sources, copy=True)
+        cands = np.array(cands, copy=True)
         if (
             sources.ndim != 1
             or cands.ndim != 2
@@ -554,6 +564,8 @@ class NeuralPathSim:
                     f"hard pool {name} out of range for this model "
                     f"(n={self.n}): [{a.min()}, {a.max()}]"
                 )
+        sources.flags.writeable = False
+        cands.flags.writeable = False
         self._hard_src, self._hard_cand = sources, cands
 
     def clear_hard_pool(self) -> None:

@@ -108,6 +108,14 @@ def main(argv=None) -> dict:
     ap.add_argument("--authors", type=int, default=200_000,
                     help="background author count")
     ap.add_argument("--bg-venues", type=int, default=4000)
+    ap.add_argument("--topics", type=int, default=1200,
+                    help="topic vocabulary size; the 2018 log constrains "
+                    "nothing about topics (the APVPA run never touches "
+                    "them), so these edges are free DBLP-plausible mass "
+                    "— dblp_small carries 10 topics at 1/123 scale. "
+                    "0 disables (pre-r05 shape).")
+    ap.add_argument("--topics-per-paper", type=float, default=1.5,
+                    help="Poisson mean of has_topic edges per paper")
     ap.add_argument("--mean-papers", type=float, default=2.6)
     ap.add_argument("--out", default="/tmp/dblp_large_reconstructed.gexf")
     ap.add_argument("--log", default=REF_LOG,
@@ -235,6 +243,29 @@ def main(argv=None) -> dict:
             draw_at += k
         for v in venues_seen:
             node(v, v, "venue")
+        # topics: Zipf-popular vocabulary, ~Poisson(topics_per_paper)
+        # has_topic edges per paper. Nothing in the 2018 log constrains
+        # them (APVPA never reads topics), so they are free to carry
+        # the same skew shape as real DBLP terms; they make APTPA /
+        # ensemble runs (reference config 4, DPathSim_APVPA.py:141)
+        # possible on the reconstruction instead of synthetic-only.
+        if args.topics > 0 and n_papers:
+            topic_w = 1.0 / np.arange(1, args.topics + 1) ** 1.05
+            topic_w /= topic_w.sum()
+            per_paper = rng.poisson(args.topics_per_paper, size=n_papers)
+            t_draws = rng.choice(
+                args.topics, size=int(per_paper.sum()), p=topic_w
+            )
+            for t in range(args.topics):
+                node(f"topic_{t}", f"topic_{t}", "topic")
+            at = 0
+            for pi in range(n_papers):
+                k = int(per_paper[pi])
+                # distinct topics per paper (duplicates would double-
+                # count a walk through the same term)
+                for t in set(t_draws[at : at + k].tolist()):
+                    edges.append((f"paper_{pi}", f"topic_{t}", "has_topic"))
+                at += k
         f.write("    </nodes>\n    <edges>\n")
         for i, (s, d, rel) in enumerate(edges):
             f.write(f'      <edge id="{i}" source="{s}" target="{d}">'
@@ -250,6 +281,7 @@ def main(argv=None) -> dict:
         "papers": n_papers,
         "venues": len(venues_seen),
         "bytes": out.stat().st_size,
+        "topics": int(args.topics),
         "constrained_targets": len(targets),
         "source_walk": source_walk,
         "seconds_build": round(time.time() - t0, 1),

@@ -141,3 +141,61 @@ def test_diagonal_variant_matches_per_path_oracle(dblp_small_hin):
         hv, hi = sc.topk(k=5, weights=w)
         sv, si = sc.topk_sharded(k=5, weights=w, n_devices=8)
         np.testing.assert_allclose(sv, hv, atol=1e-6)
+
+
+# -- streaming single-source path (r05: ensemble at dense-infeasible N) ---
+
+
+def test_topk_row_streaming_matches_dense(topic_hin):
+    """topk_row BEFORE any all-pairs call takes the O(nnz) streaming
+    path; it must agree with the dense batched result."""
+    w = [0.5, 0.3, 0.2]
+    fresh = MultiMetapathScorer(topic_hin, ["APVPA", "APTPA", "APA"])
+    dense = MultiMetapathScorer(topic_hin, ["APVPA", "APTPA", "APA"])
+    dense._compute()  # force the dense cache
+    for row in (0, 17, 123):
+        assert fresh._scores is None  # still streaming
+        rv, ri = fresh.topk_row(row, k=5, weights=w)
+        dv, di = dense.topk_row(row, k=5, weights=w)
+        np.testing.assert_allclose(rv, dv, rtol=1e-5)
+        # indexes may differ only within exact-score ties
+        for a, b, v in zip(ri, di, rv):
+            if a != b:
+                assert abs(dv[list(di).index(a)] - v) < 1e-9 if a in di \
+                    else False, (row, a, b)
+
+
+def test_global_walks_streams_without_dense_stack(topic_hin):
+    scorer = MultiMetapathScorer(topic_hin, ["APVPA", "APTPA", "APA"])
+    gw = scorer.global_walks()
+    assert scorer._scores is None and scorer._c_stack_cache is None
+    dense = MultiMetapathScorer(topic_hin, ["APVPA", "APTPA", "APA"])
+    np.testing.assert_allclose(gw, dense._compute()[1], rtol=1e-6)
+
+
+def test_global_walks_streams_diagonal_variant(topic_hin):
+    scorer = MultiMetapathScorer(
+        topic_hin, ["APVPA", "APA"], variant="diagonal"
+    )
+    gw = scorer.global_walks()
+    assert scorer._scores is None
+    dense = MultiMetapathScorer(
+        topic_hin, ["APVPA", "APA"], variant="diagonal"
+    )
+    np.testing.assert_allclose(gw, dense._compute()[1], rtol=1e-6)
+
+
+def test_dense_stack_guard_leaves_streaming_usable(topic_hin, monkeypatch):
+    """Past the stack budget the all-pairs methods refuse loudly and
+    name the widest path, while the single-source ensemble still
+    works — the 227k + APA regime in miniature."""
+    scorer = MultiMetapathScorer(topic_hin, ["APVPA", "APA"])
+    monkeypatch.setattr(
+        MultiMetapathScorer, "_DENSE_STACK_MAX_ENTRIES", 100
+    )
+    with pytest.raises(MemoryError, match="APA"):
+        scorer.scores()
+    with pytest.raises(MemoryError, match="topk_row"):
+        scorer.topk(k=3)
+    rv, ri = scorer.topk_row(5, k=3)
+    assert len(rv) == 3 and scorer._c_stack_cache is None
