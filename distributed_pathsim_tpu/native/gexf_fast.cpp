@@ -663,6 +663,18 @@ struct Parser {
     // collect this tag's declarations (with URI validation)
     for (size_t i = 0; i < tag->raw_attr_names.size(); ++i) {
       const std::string& raw = tag->raw_attr_names[i];
+      if (raw == "xmlns") {
+        // default-namespace declaration: xmlns="" (undeclaring) is
+        // legal, but binding the default to either reserved URI is
+        // not — expat (the fallback's parser) rejects both binding
+        // the xmlns URI to anything and binding the xml URI to any
+        // prefix other than "xml", the default included.
+        const std::string& uri = tag->attrs[i].value;
+        if (uri == kXmlUri || uri == kXmlnsUri) {
+          return fail("reserved namespace binding");
+        }
+        continue;
+      }
       if (raw.compare(0, 6, "xmlns:") == 0) {
         std::string pre = raw.substr(6);
         const std::string& uri = tag->attrs[i].value;

@@ -1,0 +1,87 @@
+"""Run ONE TPU client under the tunnel protocol, with custom argv.
+
+`tpu_capture_all.py` drives the fixed round-capture sequence; this is
+the escape hatch for one-off on-chip runs (a custom-shape neural
+record, a re-verification after a kernel change) under the SAME rules:
+the child self-alarms and is never signalled from outside; an
+overstayed child is ABANDONED (killing it wedges the tunnel — the
+lesson of r03/r04's lost benches); the parent never imports jax.
+
+Usage:
+  python scripts/tpu_run_one.py --alarm 5400 --log artifacts/x.txt -- \
+      scripts/neural_bench.py --platform tpu --steps 6000 ...
+  python scripts/tpu_run_one.py --alarm 1800 -- -m \
+      distributed_pathsim_tpu.cli --platform tpu ...
+
+Exit code: the child's (or 4 if it overstayed and was abandoned).
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import subprocess
+import sys
+import time
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+_WRAPPER = """
+import os, runpy, signal, sys
+os.chdir({repo!r})
+sys.path.insert(0, os.getcwd())
+signal.signal(signal.SIGALRM, lambda *_: sys.exit(3))
+signal.alarm({alarm})
+argv = {argv!r}
+if argv[0] == "-m":
+    sys.argv = argv[1:]
+    runpy.run_module(argv[1], run_name="__main__")
+else:
+    sys.argv = argv
+    runpy.run_path(argv[0], run_name="__main__")
+"""
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--alarm", type=int, default=2700,
+                    help="child self-alarm seconds (SIGALRM -> exit 3)")
+    ap.add_argument("--log", default=None,
+                    help="capture child stdout+stderr to this file")
+    ap.add_argument("child", nargs=argparse.REMAINDER,
+                    help="-- then the child argv (script or -m module)")
+    args = ap.parse_args(argv)
+    child = [a for a in args.child if a != "--"] or None
+    if not child:
+        ap.error("pass the child argv after --")
+
+    code = _WRAPPER.format(repo=str(REPO), alarm=args.alarm, argv=child)
+    out = open(args.log, "w", encoding="utf-8") if args.log else None
+    t0 = time.monotonic()
+    proc = subprocess.Popen(
+        [sys.executable, "-c", code],
+        stdout=out or None, stderr=subprocess.STDOUT if out else None,
+        cwd=str(REPO), start_new_session=True,
+    )
+    # grace beyond the alarm for interpreter teardown; NEVER a kill
+    deadline = time.monotonic() + args.alarm + 180
+    rc = None
+    while time.monotonic() < deadline:
+        rc = proc.poll()
+        if rc is not None:
+            break
+        time.sleep(5)
+    if out:
+        out.close()
+    dt = time.monotonic() - t0
+    if rc is None:
+        print(f"OVERSTAYED after {dt:.0f}s — child ABANDONED (pid "
+              f"{proc.pid}); do not launch another TPU client behind it",
+              file=sys.stderr)
+        return 4
+    print(f"child exit {rc} in {dt:.0f}s", file=sys.stderr)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -324,7 +324,23 @@ def test_differential_fuzz_python_vs_native(tmp_path):
         kind = rng.choice([
             "truncate", "byteflip", "bad_entity", "dup_line", "del_line",
             "attr_reorder", "comment", "whitespace", "insert_bytes",
+            "xmlns_decl",
         ])
+        if kind == "xmlns_decl":
+            # namespace declarations, default and prefixed, legal and
+            # reserved (ADVICE r04 #3: default-declaration divergence)
+            decl = rng.choice([
+                b' xmlns=""', b' xmlns="http://fuzz"',
+                b' xmlns="http://www.w3.org/2000/xmlns/"',
+                b' xmlns="http://www.w3.org/XML/1998/namespace"',
+                b' xmlns:f="http://fuzz"', b' xmlns:f=""',
+                b' xmlns:xmlns="http://fuzz"',
+            ])
+            i = data.find(b"<node id=")
+            if i < 0:
+                return data + decl  # degenerate; harmless
+            j = data.find(b">", i)
+            return data[:j] + decl + data[j:]
         if kind == "truncate":
             return data[: rng.randrange(1, len(data))]
         if kind == "byteflip":
@@ -561,6 +577,10 @@ def test_namespace_declaration_parity(tmp_path):
         pre + '<g><q:z q="1" xmlns:q="http://q"/></g>',
         pre + '<g xmlns:p="u1" xmlns:q="u2"><e p:a="1" q:a="2"/></g>',
         pre + '<a xmlns:xml="http://www.w3.org/XML/1998/namespace"/>',
+        # default-namespace declarations (ADVICE r04 #3): undeclaring
+        # ("") and ordinary URIs are legal
+        pre + '<a xmlns=""/>',
+        pre + '<a xmlns="http://ok"/>',
     ]
     reject = [
         pre + '<g xmlns:p="u" xmlns:q="u"><e p:a="1" q:a="2"/></g>',
@@ -571,6 +591,11 @@ def test_namespace_declaration_parity(tmp_path):
         pre + '<a xmlns:xml="http://other"/>',
         pre + '<a xmlns:p="http://www.w3.org/XML/1998/namespace"/>',
         pre + '<?a:b c?><g/>',
+        # ...but binding the DEFAULT to either reserved URI is not
+        # (expat: "prefix must not be bound to one of the reserved
+        # namespace names" — the default counts as a binding)
+        pre + '<a xmlns="http://www.w3.org/2000/xmlns/"/>',
+        pre + '<a xmlns="http://www.w3.org/XML/1998/namespace"/>',
     ]
     for doc in accept:
         assert both(doc) == ("ok", "ok"), doc
