@@ -503,16 +503,22 @@ class NeuralPathSim:
         from ..ops.chain import check_exact_counts
 
         check_exact_counts(float(self._d.max(initial=0.0)), np.float32)
+        # C and d are jit ARGUMENTS, not closure captures: a captured
+        # device array is baked into the lowered module as a constant,
+        # and the axon tunnel's remote-compile endpoint rejects the
+        # multi-GB request body (HTTP 413 at the 227k/V=4111 shape —
+        # 3.7 GB of captured constants). Arguments ride the normal
+        # buffer path and the executable is reused across chunks.
         c_dev = jnp.asarray(self._c32())
         d_dev = jnp.asarray(self._d.astype(np.float32))
 
         @jax.jit
-        def _chunk_topk(idx):
-            cs = jnp.take(c_dev, idx, axis=0)          # [T, V]
-            ds = jnp.take(d_dev, idx)                  # [T]
+        def _chunk_topk(c_all, d_all, idx):
+            cs = jnp.take(c_all, idx, axis=0)          # [T, V]
+            ds = jnp.take(d_all, idx)                  # [T]
             with jax.default_matmul_precision("highest"):
-                cc = cs @ c_dev.T                      # [T, N] on the MXU
-            denom = ds[:, None] + d_dev[None, :]
+                cc = cs @ c_all.T                      # [T, N] on the MXU
+            denom = ds[:, None] + d_all[None, :]
             sims = jnp.where(denom > 0, 2.0 * cc / denom, 0.0)
             sims = sims.at[jnp.arange(idx.shape[0]), idx].set(-jnp.inf)
             return jax.lax.top_k(sims, k)[1]
@@ -527,7 +533,9 @@ class NeuralPathSim:
                 idx = np.concatenate(
                     [idx, np.full(chunk - take, idx[-1], dtype=idx.dtype)]
                 )
-            out = np.asarray(_chunk_topk(jnp.asarray(idx, jnp.int32)))
+            out = np.asarray(
+                _chunk_topk(c_dev, d_dev, jnp.asarray(idx, jnp.int32))
+            )
             cands[lo:lo + take] = out[:take]
         return sources, cands
 
