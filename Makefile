@@ -1,0 +1,17 @@
+# Developer entry points. Everything runs on CPU (JAX_PLATFORMS=cpu);
+# TPU runs go through scripts/tpu_run_one.py under the tunnel protocol.
+
+PYTHON ?= python
+
+.PHONY: test chaos
+
+# Tier-1: the fast CPU suite (the driver's acceptance gate).
+test:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m 'not slow' \
+		--continue-on-collection-errors -p no:cacheprovider
+
+# Chaos: the tier-1 suite under a fixed fault-injection schedule —
+# targeted recovery tests first, then the whole suite with
+# PATHSIM_FAULT_PLAN injecting one transient failure per seam.
+chaos:
+	$(PYTHON) scripts/chaos_suite.py

@@ -13,9 +13,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import resilience
 from ..ops import pallas_kernels as pk
 from ..ops import sparse as sp
 from ..ops.metapath import MetaPath
+from ..resilience.preemption import handler as _preemption
 from .base import PathSimBackend, register_backend
 
 # Refuse to densify all-pairs outputs beyond this many entries (16k×16k
@@ -323,67 +325,103 @@ class JaxSparseBackend(PathSimBackend):
                 vals[i0 : i0 + rows_here] = unit["vals"]
                 idxs[i0 : i0 + rows_here] = unit["idxs"]
                 continue
-            d_all = rowsums_device()
-            if scanned and self._use_rect_kernel(k):
-                # Fastest path: the rectangular two-pass Pallas kernel
-                # scores this row tile against the whole column range on
-                # the MXU (packed candidate extraction, exact reduce) —
-                # measured 4.6× the lax.scan fold at N=1M, V=64 on a
-                # v5e (740 s → 162 s rank-all; SCALE_r03_TPU.json).
-                # The factor is padded to kernel shape once (cached):
-                # the kernel skips its own O(N·128) pad on every call.
-                # The cache is VARIANT-KEYED: dc is the denominator
-                # vector, and reusing a rowsum-padded dc for a diagonal
-                # pass would silently score the wrong variant.
-                if (
-                    self._rect_factor is None
-                    or self._rect_factor[0] != variant
-                ):
-                    self._rect_factor = (
-                        variant,
-                        *pk.rect_pad_factor(t.dense_device(), d_all),
-                    )
-                    # the rect path only ever slices the padded copy —
-                    # holding the unpadded dense C too would double the
-                    # factor's HBM residency for the whole pass
-                    t.drop_dense()
-                _, cc, dc = self._rect_factor
-                ci = jax.lax.dynamic_slice(
-                    cc, (i0, 0), (t.tile_rows, cc.shape[1])
+            # Preemption point: everything in `pending` is flushed (and
+            # checkpointed) first, so the manifest covers every tile the
+            # device finished — the restart redoes only tile i onward.
+            if _preemption.requested():
+                while pending:
+                    _drain_one()
+                _preemption.check(
+                    checkpoint_dir=str(ckpt.dir) if ckpt is not None else None
                 )
-                di = jax.lax.dynamic_slice(dc, (i0,), (t.tile_rows,))
-                row_ids = i0 + jnp.arange(t.tile_rows, dtype=jnp.int32)
-                best_v, best_i = pk.fused_topk_twopass_rect(
-                    ci, cc, di, dc, row_ids,
-                    k=k, n_true_cols=self.n,
-                    interpret=not pk.pallas_supported(),
+            try:
+                best_v, best_i = resilience.resilient_call(
+                    "tile_execute",
+                    lambda i=i, i0=i0: self._topk_row_tile(
+                        i, i0, k, variant, rowsums_device, scanned
+                    ),
                 )
-            elif scanned:
-                # One dispatch for the whole column sweep (lax.scan on
-                # device) — same fold order and numerics as the tile
-                # loop below, minus n_tiles round-trips per row tile.
-                best_v, best_i = sp.stream_row_tile_topk(
-                    t.dense_device(), d_all, jnp.int32(i0),
-                    k=k, n_true=self.n, tile_rows=t.tile_rows,
-                )
-            else:
-                ci = t.tile(i)
-                di = d_all[i0 : i0 + t.tile_rows]
-                best_v = jnp.full((t.tile_rows, k), -jnp.inf, dtype=t.dtype)
-                best_i = jnp.zeros((t.tile_rows, k), dtype=jnp.int32)
-                for j in range(t.n_tiles):
-                    j0 = j * t.tile_rows
-                    best_v, best_i = sp.stream_merge_topk(
-                        ci, t.tile(j), di, d_all[j0 : j0 + t.tile_rows],
-                        best_v, best_i,
-                        jnp.int32(i0), jnp.int32(j0), k=k, n_true=self.n,
-                    )
+            except BaseException:
+                # The tiles in `pending` finished on the device before
+                # this one failed — flush them to the checkpoint (best
+                # effort: the device may be wedged) so the failure costs
+                # one tile of progress, not the pipeline depth.
+                if ckpt is not None:
+                    try:
+                        while pending:
+                            _drain_one()
+                    except Exception:
+                        pass
+                raise
             pending.append((i, i0, rows_here, best_v, best_i))
             while len(pending) >= self._PIPELINE_DEPTH:
                 _drain_one()
         while pending:
             _drain_one()
         return vals, idxs
+
+    def _topk_row_tile(self, i: int, i0: int, k: int, variant: str,
+                       rowsums_device, scanned: bool):
+        """One row tile's streaming top-k dispatch — the ``tile_execute``
+        resilience seam's unit of retry. Stateless w.r.t. the sweep
+        (the rect factor cache is rebuilt idempotently), so recomputing
+        a tile after a transient failure yields identical results."""
+        t = self.tiled
+        d_all = rowsums_device()
+        if scanned and self._use_rect_kernel(k):
+            # Fastest path: the rectangular two-pass Pallas kernel
+            # scores this row tile against the whole column range on
+            # the MXU (packed candidate extraction, exact reduce) —
+            # measured 4.6× the lax.scan fold at N=1M, V=64 on a
+            # v5e (740 s → 162 s rank-all; SCALE_r03_TPU.json).
+            # The factor is padded to kernel shape once (cached):
+            # the kernel skips its own O(N·128) pad on every call.
+            # The cache is VARIANT-KEYED: dc is the denominator
+            # vector, and reusing a rowsum-padded dc for a diagonal
+            # pass would silently score the wrong variant.
+            if (
+                self._rect_factor is None
+                or self._rect_factor[0] != variant
+            ):
+                self._rect_factor = (
+                    variant,
+                    *pk.rect_pad_factor(t.dense_device(), d_all),
+                )
+                # the rect path only ever slices the padded copy —
+                # holding the unpadded dense C too would double the
+                # factor's HBM residency for the whole pass
+                t.drop_dense()
+            _, cc, dc = self._rect_factor
+            ci = jax.lax.dynamic_slice(
+                cc, (i0, 0), (t.tile_rows, cc.shape[1])
+            )
+            di = jax.lax.dynamic_slice(dc, (i0,), (t.tile_rows,))
+            row_ids = i0 + jnp.arange(t.tile_rows, dtype=jnp.int32)
+            return pk.fused_topk_twopass_rect(
+                ci, cc, di, dc, row_ids,
+                k=k, n_true_cols=self.n,
+                interpret=not pk.pallas_supported(),
+            )
+        if scanned:
+            # One dispatch for the whole column sweep (lax.scan on
+            # device) — same fold order and numerics as the tile
+            # loop below, minus n_tiles round-trips per row tile.
+            return sp.stream_row_tile_topk(
+                t.dense_device(), d_all, jnp.int32(i0),
+                k=k, n_true=self.n, tile_rows=t.tile_rows,
+            )
+        ci = t.tile(i)
+        di = d_all[i0 : i0 + t.tile_rows]
+        best_v = jnp.full((t.tile_rows, k), -jnp.inf, dtype=t.dtype)
+        best_i = jnp.zeros((t.tile_rows, k), dtype=jnp.int32)
+        for j in range(t.n_tiles):
+            j0 = j * t.tile_rows
+            best_v, best_i = sp.stream_merge_topk(
+                ci, t.tile(j), di, d_all[j0 : j0 + t.tile_rows],
+                best_v, best_i,
+                jnp.int32(i0), jnp.int32(j0), k=k, n_true=self.n,
+            )
+        return best_v, best_i
 
     # In-flight row tiles (device [tile, k] pairs — tiny); 3 keeps one
     # tile fetching, one computing, one queued.
@@ -498,24 +536,48 @@ class JaxSparseBackend(PathSimBackend):
                 start = after + 1
 
         for i in range(start, t.n_tiles):
+            # Preemption point (outer-tile boundary): every finished row
+            # unit is already durable; a fresh partials snapshot makes
+            # the restart resume exactly here instead of at the last
+            # cadence snapshot.
+            if _preemption.requested():
+                if ckpt is not None and i > start:
+                    prev_key = self._save_sym_partials(
+                        ckpt, best, after=i - 1, prev_key=prev_key, k=k
+                    )
+                _preemption.check(
+                    checkpoint_dir=str(ckpt.dir) if ckpt is not None else None
+                )
             i0 = i * t.tile_rows
             rows_here = min(t.tile_rows, self.n - i0)
             ci = t.tile(i)
             d_all = rowsums_device()
             di = d_all[i0 : i0 + t.tile_rows]
             bv, bi = best[i]
-            bv, bi = sp.stream_merge_topk(
-                ci, ci, di, di, bv, bi,
-                jnp.int32(i0), jnp.int32(i0), k=k, n_true=self.n,
+            # Each merge is one tile_execute attempt: results are
+            # assigned only on success, so a retried merge never folds
+            # the same tile into the running best twice (the merge is
+            # NOT idempotent — a duplicate fold would duplicate
+            # candidate indices in the top-k list).
+            bv, bi = resilience.resilient_call(
+                "tile_execute",
+                lambda: sp.stream_merge_topk(
+                    ci, ci, di, di, bv, bi,
+                    jnp.int32(i0), jnp.int32(i0), k=k, n_true=self.n,
+                ),
             )
             for j in range(i + 1, t.n_tiles):
                 j0 = j * t.tile_rows
                 cj = t.tile(j)
                 dj = d_all[j0 : j0 + t.tile_rows]
                 bjv, bji = best[j]
-                bv, bi, bjv, bji = sp.stream_merge_topk_pair(
-                    ci, cj, di, dj, bv, bi, bjv, bji,
-                    jnp.int32(i0), jnp.int32(j0), k=k, n_true=self.n,
+                bv, bi, bjv, bji = resilience.resilient_call(
+                    "tile_execute",
+                    lambda cj=cj, dj=dj, j0=j0, bv=bv, bi=bi, bjv=bjv,
+                    bji=bji: sp.stream_merge_topk_pair(
+                        ci, cj, di, dj, bv, bi, bjv, bji,
+                        jnp.int32(i0), jnp.int32(j0), k=k, n_true=self.n,
+                    ),
                 )
                 best[j] = (bjv, bji)
             vals[i0 : i0 + rows_here] = np.asarray(
@@ -533,24 +595,36 @@ class JaxSparseBackend(PathSimBackend):
                 )
                 last = i == t.n_tiles - 1
                 if i % self._PARTIALS_EVERY == self._PARTIALS_EVERY - 1 or last:
-                    rest = range(i + 1, t.n_tiles)
-                    jax.block_until_ready([best[j][0] for j in rest])
-                    new_key = f"{self._PARTIALS_PREFIX}{i}"
-                    ckpt.save_unit(
-                        new_key,
-                        vals=np.stack(
-                            [np.asarray(best[j][0]) for j in rest]
-                        ) if len(rest) else np.zeros((0, t.tile_rows, k)),
-                        idxs=np.stack(
-                            [np.asarray(best[j][1]) for j in rest]
-                        ) if len(rest) else np.zeros(
-                            (0, t.tile_rows, k), dtype=np.int32
-                        ),
+                    prev_key = self._save_sym_partials(
+                        ckpt, best, after=i, prev_key=prev_key, k=k
                     )
-                    if prev_key is not None:
-                        ckpt.drop_unit(prev_key)  # only after the new
-                    prev_key = new_key  # snapshot is durable
         return vals, idxs
+
+    def _save_sym_partials(self, ckpt, best: dict, after: int,
+                           prev_key: str | None, k: int) -> str:
+        """Snapshot the running bests of row tiles > ``after`` under
+        ``sym_partials_after_{after}`` and drop the superseded snapshot
+        only once the new one is durable (save_unit writes all arrays
+        before the manifest references them). Idempotent: re-saving the
+        same key overwrites identical contents."""
+        t = self.tiled
+        rest = range(after + 1, t.n_tiles)
+        jax.block_until_ready([best[j][0] for j in rest])
+        new_key = f"{self._PARTIALS_PREFIX}{after}"
+        ckpt.save_unit(
+            new_key,
+            vals=np.stack(
+                [np.asarray(best[j][0]) for j in rest]
+            ) if len(rest) else np.zeros((0, t.tile_rows, k)),
+            idxs=np.stack(
+                [np.asarray(best[j][1]) for j in rest]
+            ) if len(rest) else np.zeros(
+                (0, t.tile_rows, k), dtype=np.int32
+            ),
+        )
+        if prev_key is not None and prev_key != new_key:
+            ckpt.drop_unit(prev_key)  # only after the new one is durable
+        return new_key
 
     # ------------------------------------------------------------------
     # Exact-counts phase (counts past 2^24): f64 host rescoring of the

@@ -103,6 +103,20 @@ def build_parser() -> argparse.ArgumentParser:
         "checkpointed ring step)",
     )
     p.add_argument(
+        "--max-retries",
+        type=int,
+        default=None,
+        help="attempts per failure seam (GEXF load, compile, backend "
+        "init, tile execute, checkpoint write); default from "
+        "PATHSIM_MAX_RETRIES or 3. 1 disables retries",
+    )
+    p.add_argument(
+        "--no-degrade",
+        action="store_true",
+        help="fail fast instead of stepping down the backend chain "
+        "(jax-sharded→jax→numpy) when backend init keeps failing",
+    )
+    p.add_argument(
         "--coordinator-address",
         default=None,
         help="multi-host rendezvous address host:port (jax.distributed); "
@@ -124,6 +138,13 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv: list[str] | None = None) -> int:
+    from .resilience import PREEMPTED_EXIT_CODE, Preempted, preemption_handler
+
+    # SIGTERM/SIGINT become a graceful preemption: the streaming tile
+    # loop flushes its in-flight work through the CheckpointManager and
+    # raises Preempted; we exit 75 (EX_TEMPFAIL — "re-run me") with a
+    # one-line resume hint. A second signal aborts the drain.
+    installed = preemption_handler.install()
     try:
         args = build_parser().parse_args(argv)
         _apply_platform(args.platform)  # before ANY backend touch
@@ -132,12 +153,19 @@ def main(argv: list[str] | None = None) -> int:
 
         with device_trace(args.profile_dir):
             return _run(args)
+    except Preempted as exc:
+        print(f"preempted: {exc}", file=sys.stderr)
+        return PREEMPTED_EXIT_CODE
     except (KeyError, ValueError, OverflowError, FileNotFoundError) as exc:
         # Known, user-actionable failures render as one clean line; anything
         # unexpected still gets a full traceback.
         msg = exc.args[0] if exc.args else exc
         print(f"error: {msg}", file=sys.stderr)
         return 1
+    finally:
+        if installed:
+            preemption_handler.uninstall()
+            preemption_handler.reset()
 
 
 def _apply_platform(platform: str) -> None:
@@ -251,6 +279,12 @@ def _init_multihost(args) -> None:
 
 
 def _run(args) -> int:
+    if args.max_retries is not None:
+        # Seams deep in the stack (per-tile execute, checkpoint write,
+        # ring steps) build their policy from the environment — export
+        # the flag so EVERY seam honors it, not just the bootstrap ones
+        # that receive the policy object explicitly.
+        os.environ["PATHSIM_MAX_RETRIES"] = str(args.max_retries)
     if "," in args.metapath:
         return _run_multipath(args)
     if args.ranking_out or args.checkpoint_dir:
@@ -294,20 +328,27 @@ def _run(args) -> int:
         tile_rows=args.tile_rows,
         approx=args.approx,
         echo=not args.quiet,
+        max_retries=args.max_retries,
+        degrade=not args.no_degrade,
     )
 
+    from .utils.logging import set_event_sink
     from .utils.profiling import StageTimer
 
     # One logger + timer for the whole run: bootstrap stage timings
     # (load/encode, metapath compile, backend init) and compute stages
-    # all land in the same --metrics JSONL.
+    # all land in the same --metrics JSONL. Registering it as the event
+    # sink routes resilience events (retry/degrade/preempt/injection)
+    # into the same JSONL stream.
     logger = RunLogger(
         output_path=config.output, echo=config.echo, metrics_path=config.metrics
     )
+    set_event_sink(logger)
     timer = StageTimer(logger)
     try:
         return _run_modes(args, config, logger, timer)
     finally:
+        set_event_sink(None)
         logger.close()
 
 
@@ -385,6 +426,9 @@ def _run_multipath(args) -> int:
         "--checkpoint-dir": args.checkpoint_dir is not None,
         "--tile-rows": args.tile_rows is not None,
         "--approx": args.approx,
+        # no backend chain to step down in this mode — refuse rather
+        # than silently ignore
+        "--no-degrade": args.no_degrade,
     }
     bad = [flag for flag, hit in unsupported.items() if hit]
     if bad:
@@ -403,10 +447,13 @@ def _run_multipath(args) -> int:
             "all-sources ranking (--top-k without --source)"
         )
 
+    from . import resilience
     from .engine import USE_NATIVE_BY_LOADER
 
     hin = load_dataset(
-        args.dataset, use_native=USE_NATIVE_BY_LOADER[args.loader]
+        args.dataset,
+        use_native=USE_NATIVE_BY_LOADER[args.loader],
+        policy=resilience.policy_from_env(max_attempts=args.max_retries),
     )
     if args.platform == "tpu":
         _require_tpu()  # load_dataset stays host-side; check before compute

@@ -30,3 +30,8 @@ class RunConfig:
     tile_rows: int | None = None  # jax-sparse: rows per streaming tile
     approx: bool = False  # jax-sparse: waive the exact-count guard
     echo: bool = True
+    # Resilience knobs (see resilience/): None = PATHSIM_MAX_RETRIES env
+    # default (3 attempts total); degrade=False makes backend-init
+    # failures fatal instead of stepping down the chain.
+    max_retries: int | None = None
+    degrade: bool = True

@@ -15,6 +15,7 @@ import time
 
 import numpy as np
 
+from . import resilience
 from .backends.base import PathSimBackend
 from .utils.logging import RunLogger
 
@@ -76,10 +77,19 @@ class PathSimDriver:
         # Where the time actually goes (the reference's per-stage clock
         # measures its joins; here the compute collapses to two device
         # dispatches + host formatting, so the split is the useful signal).
+        # Both device computations sit behind the device_execute seam:
+        # a transient dispatch failure (wedged tunnel, preempted device)
+        # is retried rather than killing the run.
         with timer.stage("device_denominators"):
-            d = self.backend._denominators(self.variant)
+            d = resilience.resilient_call(
+                "device_execute",
+                lambda: self.backend._denominators(self.variant),
+            )
         with timer.stage("device_pairwise_row"):
-            row = self.backend.pairwise_row(source_index)
+            row = resilience.resilient_call(
+                "device_execute",
+                lambda: self.backend.pairwise_row(source_index),
+            )
         source_label = self.index.labels[source_index]
         source_id = self.index.ids[source_index]
 
@@ -128,7 +138,10 @@ class PathSimDriver:
     def run_all_pairs(self) -> np.ndarray:
         """All-pairs score matrix — the capability the reference
         extrapolates to ~24 h of joins (SURVEY.md §6)."""
-        return self.backend.all_pairs_scores(variant=self.variant)
+        return resilience.resilient_call(
+            "device_execute",
+            lambda: self.backend.all_pairs_scores(variant=self.variant),
+        )
 
     def rank_all(self, k: int = 10, checkpoint_dir: str | None = None):
         """Per-source top-k ranking for EVERY node: (values [N, k] f64,
@@ -153,13 +166,20 @@ class PathSimDriver:
                 "(jax-sparse or jax-sharded)"
             )
         if hasattr(b, "topk") and b.metapath.is_symmetric:
-            vals, idxs = b.topk(k=k, mask_self=True, variant=self.variant)
+            vals, idxs = resilience.resilient_call(
+                "device_execute",
+                lambda: b.topk(k=k, mask_self=True, variant=self.variant),
+            )
             return (
                 np.asarray(vals, dtype=np.float64),
                 np.asarray(idxs, dtype=np.int64),
             )
         scores = np.array(
-            b.all_pairs_scores(variant=self.variant), dtype=np.float64
+            resilience.resilient_call(
+                "device_execute",
+                lambda: b.all_pairs_scores(variant=self.variant),
+            ),
+            dtype=np.float64,
         )
         np.fill_diagonal(scores, -np.inf)
         idxs = np.argsort(-scores, axis=1, kind="stable")[:, :k]

@@ -8,12 +8,15 @@ the execution strategy instead of a pinned JVM package.
 
 from __future__ import annotations
 
-from .backends.base import PathSimBackend, create_backend
+from . import resilience
+from .backends.base import PathSimBackend
 from .config import RunConfig
 from .data.encode import EncodedHIN, encode_hin
 from .data.gexf import read_gexf
 from .driver import PathSimDriver
 from .ops.metapath import MetaPath, compile_metapath
+from .resilience.policy import RetryPolicy
+from .utils.logging import runtime_event
 
 
 # --loader choice → read path: None prefers native with clean fallback,
@@ -22,31 +25,77 @@ from .ops.metapath import MetaPath, compile_metapath
 USE_NATIVE_BY_LOADER = {"auto": None, "python": False, "native": True}
 
 
-def load_dataset(path: str, use_native: bool | None = None) -> EncodedHIN:
+class _NativeUnavailable(Exception):
+    """Native loader absent (no toolchain / import failure) — a
+    deterministic condition, not a transient fault: never retried, falls
+    straight to the Python pipeline."""
+
+
+def _load_native(path: str) -> EncodedHIN:
+    from .native import gexf_native
+
+    if not gexf_native.available():
+        raise _NativeUnavailable()
+    # Parse + encode in one native pass: no per-edge Python objects
+    # (the marshalling, not the XML, dominates at dblp_large scale —
+    # see scripts/parser_bench.py artifact).
+    return gexf_native.read_gexf_encoded(path)
+
+
+def load_dataset(
+    path: str,
+    use_native: bool | None = None,
+    policy: RetryPolicy | None = None,
+) -> EncodedHIN:
     """GEXF → EncodedHIN. ``use_native`` mirrors read_gexf's tri-state:
     None prefers the C++ single-pass parse+encode with clean fallback,
     False forces the exact Python pipeline (the escape hatch if the
-    native path ever misbehaves), True requires native."""
+    native path ever misbehaves), True requires native.
+
+    This is the ``gexf_load`` failure seam: each read path is retried
+    under ``policy``; with ``use_native=None`` a native loader that
+    keeps failing transiently degrades to the exact Python pipeline
+    (with a structured ``degrade`` event) instead of killing the run."""
+    # A missing file is deterministic, not transient: without this
+    # filter the OSError-retryable default would back off 3x against a
+    # typo'd path and emit a misleading loader-degrade event before the
+    # CLI's clean one-line error.
+    policy = policy or resilience.policy_from_env()
+    policy = policy.replace(
+        non_retryable=policy.non_retryable + (FileNotFoundError,)
+    )
     if use_native is not False:
         try:
-            from .native import gexf_native
-
-            if gexf_native.available():
-                # Parse + encode in one native pass: no per-edge Python
-                # objects (the marshalling, not the XML, dominates at
-                # dblp_large scale — see scripts/parser_bench.py artifact).
-                return gexf_native.read_gexf_encoded(path)
+            return resilience.resilient_call(
+                "gexf_load", lambda: _load_native(path), policy
+            )
+        except FileNotFoundError:
+            raise
+        except (_NativeUnavailable, ImportError):
             if use_native is True:
                 # ValueError: the CLI renders it as a clean one-liner.
                 raise ValueError(
                     "native GEXF loader requested but unavailable "
                     "(no C++ toolchain?)"
-                )
-        except OSError as exc:  # toolchain/loader trouble: Python is exact
+                ) from None
+            # Loader simply not built — the normal CPU-dev case; quiet.
+        except (OSError, resilience.TransientError) as exc:
             if use_native is True:
                 raise ValueError(f"native GEXF loader failed: {exc}") from exc
-    graph = read_gexf(path, use_native=False if use_native is False else None)
-    return encode_hin(graph)
+            runtime_event(
+                "degrade",
+                component="loader",
+                from_="native",
+                to="python",
+                error=repr(exc),
+            )
+    return resilience.resilient_call(
+        "gexf_load",
+        lambda: encode_hin(
+            read_gexf(path, use_native=False if use_native is False else None)
+        ),
+        policy,
+    )
 
 
 def build(
@@ -54,7 +103,12 @@ def build(
 ) -> tuple[EncodedHIN, MetaPath, PathSimBackend, PathSimDriver]:
     """``timer``: optional StageTimer; bootstrap phases (GEXF load +
     encode, metapath compile, backend init — which for the sparse
-    backend includes the host half-chain fold) are recorded on it."""
+    backend includes the host half-chain fold) are recorded on it.
+
+    Every bootstrap phase is a resilience seam: transient failures are
+    retried per ``config.max_retries``; a backend whose init keeps
+    failing steps down the degradation chain (jax-sharded → jax →
+    numpy) unless ``config.degrade`` is False."""
     if timer is None:
         from .utils.profiling import StageTimer
 
@@ -64,12 +118,19 @@ def build(
             f"unknown loader {config.loader!r}; "
             f"choose from {sorted(USE_NATIVE_BY_LOADER)}"
         )
+    policy = resilience.policy_from_env(max_attempts=config.max_retries)
     with timer.stage("load_encode"):
         hin = load_dataset(
-            config.dataset, use_native=USE_NATIVE_BY_LOADER[config.loader]
+            config.dataset,
+            use_native=USE_NATIVE_BY_LOADER[config.loader],
+            policy=policy,
         )
     with timer.stage("metapath_compile"):
-        metapath = compile_metapath(config.metapath, hin.schema)
+        metapath = resilience.resilient_call(
+            "metapath_compile",
+            lambda: compile_metapath(config.metapath, hin.schema),
+            policy,
+        )
     options = {}
     if config.n_devices is not None:
         options["n_devices"] = config.n_devices
@@ -80,7 +141,14 @@ def build(
     if config.approx:
         options["exact_counts"] = False
     with timer.stage("backend_init"):
-        backend = create_backend(config.backend, hin, metapath, **options)
+        backend = resilience.create_backend_resilient(
+            config.backend,
+            hin,
+            metapath,
+            policy=policy,
+            degrade=config.degrade,
+            **options,
+        )
     driver = PathSimDriver(backend, variant=config.variant)
     return hin, metapath, backend, driver
 

@@ -23,6 +23,7 @@ import numpy as np
 
 from ..data.encode import EncodedHIN
 from ..ops import chain
+from ..utils.compat import shard_map
 from ..ops.metapath import MetaPath, compile_metapath
 
 
@@ -69,7 +70,7 @@ def _sharded_combined_topk(c_stack, weights, mesh, k: int, n_true: int,
     from ..ops.sparse import chunked_row_topk
 
     @functools.partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(P(None, "dp", None), P()),
         out_specs=(P("dp", None), P("dp", None)),
@@ -187,11 +188,15 @@ class MultiMetapathScorer:
     def _compute(self):
         if self._scores is None:
             s, d = _batched_scores(self._stack(), variant=self.variant)
+            d64 = np.asarray(d, dtype=np.float64)
+            # Guard BEFORE caching: if the exactness check raises, the
+            # streaming state must stay intact — otherwise a later
+            # scores()/topk_row() call would silently serve the inexact
+            # f32-derived cache, and an exact streaming _rowsums (from
+            # global_walks) would have been clobbered (ADVICE r5).
+            chain.check_exact_counts(d64.max(initial=0.0), np.float32)
             self._scores = np.asarray(s)
-            self._rowsums = np.asarray(d, dtype=np.float64)
-            chain.check_exact_counts(
-                self._rowsums.max(initial=0.0), np.float32
-            )
+            self._rowsums = d64
         return self._scores, self._rowsums
 
     def _streaming_rowsums(self) -> np.ndarray:
@@ -313,14 +318,14 @@ class MultiMetapathScorer:
         )
 
     def topk_row(self, row: int, k: int = 10, weights: Sequence[float] | None = None):
-        """Top-k for ONE source row — ranks only that row, via the
-        streaming O(nnz) path (reuses the dense cache when an all-pairs
-        method already built it)."""
-        if self._scores is not None:
-            s = self.combined_scores(weights)[row].astype(np.float64)
-        else:
-            w = self._resolve_weights(weights).astype(np.float64)
-            s = np.einsum("rn,r->n", self._row_scores_streaming(row), w)
+        """Top-k for ONE source row — ranks only that row, ALWAYS via
+        the streaming exact-f64 O(nnz) path. The dense f32 all-pairs
+        cache is deliberately not reused here: results must be
+        call-order independent — the same query on the same scorer
+        returned slightly different scores and tie orders depending on
+        whether an all-pairs method had run first (ADVICE r5)."""
+        w = self._resolve_weights(weights).astype(np.float64)
+        s = np.einsum("rn,r->n", self._row_scores_streaming(row), w)
         s[row] = -np.inf
         k = min(k, s.shape[0] - 1)
         part = np.argpartition(-s, k - 1)[:k]

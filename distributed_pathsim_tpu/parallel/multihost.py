@@ -107,11 +107,29 @@ def initialize_multihost(
             "use (jax.devices(), computations, device_put, …); move it to "
             "program start"
         )
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
-        **kwargs,
+    # The rendezvous is a network operation against a coordinator that
+    # may not be up yet (hosts race at job start) — the multihost_init
+    # resilience seam retries it with backoff. RuntimeError is added to
+    # the retryable set here because jax.distributed surfaces transient
+    # gRPC failures (UNAVAILABLE, DEADLINE_EXCEEDED) as RuntimeError;
+    # InjectedCrash (a RuntimeError subclass meaning "hard kill") must
+    # stay non-retryable or chaos 'crash' rules would be absorbed here.
+    from .. import resilience
+
+    policy = resilience.policy_from_env()
+    policy = policy.replace(
+        retryable=policy.retryable + (RuntimeError,),
+        non_retryable=policy.non_retryable + (resilience.InjectedCrash,),
+    )
+    resilience.resilient_call(
+        "multihost_init",
+        lambda: jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            **kwargs,
+        ),
+        policy,
     )
     return jax.process_count() > 1
 

@@ -19,6 +19,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ..utils import compat
+
 from ..ops.sparse import chunked_row_topk
 
 
@@ -29,7 +31,7 @@ def ring_allpairs_rowblock(c_local: jax.Array, axis: str) -> jax.Array:
     c_local: [n_loc, V] — this device's rows of C.
     Returns [n_loc, n_dev * n_loc] — this device's rows of M (padded N).
     """
-    n_dev = jax.lax.axis_size(axis)
+    n_dev = compat.axis_size(axis)
     my = jax.lax.axis_index(axis)
     n_loc = c_local.shape[0]
     perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
@@ -47,7 +49,7 @@ def ring_allpairs_rowblock(c_local: jax.Array, axis: str) -> jax.Array:
 
     # pcast: the accumulator is device-varying (each device builds different
     # rows of M) — shard_map's varying-axis tracking needs that declared.
-    m0 = jax.lax.pcast(
+    m0 = compat.pcast(
         jnp.zeros((n_loc, n_dev * n_loc), dtype=c_local.dtype),
         (axis,),
         to="varying",
@@ -109,7 +111,7 @@ def ring_topk_rowblock(
         use_pallas = pk.pallas_supported() and pk.rect_supported(
             c_local.shape[1], k
         )
-    n_dev = jax.lax.axis_size(axis)
+    n_dev = compat.axis_size(axis)
     my = jax.lax.axis_index(axis)
     n_loc = c_local.shape[0]
     perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
@@ -122,12 +124,12 @@ def ring_topk_rowblock(
             use_pallas=use_pallas,
         )
 
-    best_v0 = jax.lax.pcast(
+    best_v0 = compat.pcast(
         jnp.full((n_loc, k), -jnp.inf, dtype=c_local.dtype),
         (axis,),
         to="varying",
     )
-    best_i0 = jax.lax.pcast(
+    best_i0 = compat.pcast(
         jnp.zeros((n_loc, k), dtype=jnp.int32), (axis,), to="varying"
     )
     _, _, best_v, best_i = jax.lax.fori_loop(
@@ -162,7 +164,7 @@ def ring_topk_step(
     (block, d_block, best_v, best_i)."""
     from ..ops import pallas_kernels as pk
 
-    n_dev = jax.lax.axis_size(axis)
+    n_dev = compat.axis_size(axis)
     my = jax.lax.axis_index(axis)
     n_loc = c_local.shape[0]
     perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]

@@ -30,6 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..utils.compat import shard_map
 from .mesh import pad_to_multiple
 from .ring import ring_allpairs_rowblock, ring_topk_rowblock
 
@@ -96,7 +97,7 @@ def sharded_chain_outputs(
     """
 
     @functools.partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(P(axis, None), tuple(P() for _ in rest)),
         out_specs=(P(axis, None) if want_m else P(), P(axis)),
@@ -166,7 +167,7 @@ def sharded_topk(
     # workaround pass check_vma=False"). The jnp fold keeps the checker.
 
     @functools.partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(P(axis, None), tuple(P() for _ in rest)),
         out_specs=(P(axis, None), P(axis, None)),
@@ -207,7 +208,7 @@ def sharded_ring_state(
     recomputed on every resume so checkpoints never persist O(N·V)."""
 
     @functools.partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(P(axis, None), tuple(P() for _ in rest)),
         out_specs=(P(axis, None), P(axis)),
@@ -249,7 +250,7 @@ def sharded_ring_step(
     program."""
 
     @functools.partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(
             P(axis, None), P(axis), P(axis, None), P(axis),
@@ -374,20 +375,57 @@ def sharded_topk_stepwise(
     else:
         block, d_block = c, d
 
-    for t in range(start, n_dev):
-        block, d_block, best_v, best_i = sharded_ring_step(
-            c, d, block, d_block, best_v, best_i, t,
-            mesh=mesh, k=k, n_true=n_true, axis=axis,
-            mask_self=mask_self, use_pallas=use_pallas,
+    from .. import resilience
+    from ..resilience.preemption import handler as _preemption
+
+    # Per-process retry and one-host preemption flushes are only sound
+    # single-controller: in a multi-host job every process must issue
+    # the identical sequence of SPMD programs, so a retry (or a flush
+    # collective) on ONE host would desynchronize the cluster. There
+    # the steps run bare — multi-host recovery is job-level (the
+    # scheduler restarts all hosts; the checkpoint still resumes).
+    single_controller = jax.process_count() == 1
+
+    def _snapshot(after: int, prev_key):
+        """Durable running-bests snapshot for resume at step after+1;
+        drops the superseded snapshot only once the new one landed."""
+        new_key = f"ring_bests_after_{after}"
+        ckpt.save_unit(
+            new_key,
+            vals=_fetch_global(best_v),
+            idxs=_fetch_global(best_i),
         )
-        if ckpt is not None and (t % every == every - 1 or t == n_dev - 1):
-            new_key = f"ring_bests_after_{t}"
-            ckpt.save_unit(
-                new_key,
-                vals=_fetch_global(best_v),
-                idxs=_fetch_global(best_i),
+        if prev_key is not None and prev_key != new_key:
+            ckpt.drop_unit(prev_key)  # only after the new is durable
+        return new_key
+
+    for t in range(start, n_dev):
+        # Preemption point (ring-step boundary): flush the running
+        # bests as a fresh snapshot so the restart resumes at step t,
+        # not at the last `every`-cadence snapshot.
+        if single_controller and _preemption.requested():
+            if ckpt is not None and t > start:
+                prev_key = _snapshot(t - 1, prev_key)
+            _preemption.check(
+                checkpoint_dir=str(ckpt.dir) if ckpt is not None else None
             )
-            if prev_key is not None and prev_key != new_key:
-                ckpt.drop_unit(prev_key)  # only after the new is durable
-            prev_key = new_key
+        # One ring step = one tile_execute attempt: the step is
+        # functional (new carries returned, assigned on success), so a
+        # transient dispatch failure retries without double-folding.
+        step = (
+            lambda t=t, block=block, d_block=d_block, bv=best_v,
+            bi=best_i: sharded_ring_step(
+                c, d, block, d_block, bv, bi, t,
+                mesh=mesh, k=k, n_true=n_true, axis=axis,
+                mask_self=mask_self, use_pallas=use_pallas,
+            )
+        )
+        if single_controller:
+            block, d_block, best_v, best_i = resilience.resilient_call(
+                "tile_execute", step
+            )
+        else:
+            block, d_block, best_v, best_i = step()
+        if ckpt is not None and (t % every == every - 1 or t == n_dev - 1):
+            prev_key = _snapshot(t, prev_key)
     return best_v, best_i

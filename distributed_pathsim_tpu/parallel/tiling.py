@@ -25,6 +25,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.sparse import chunked_row_topk
+from ..utils.compat import shard_map
 from .mesh import pad_to_multiple
 
 
@@ -63,7 +64,7 @@ def tiled_scores_2d(c_row, c_col, d_row, d_col, mesh: Mesh,
     dp, tp = axes
 
     run = functools.partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(P(dp, None), P(tp, None), P(dp), P(tp)),
         out_specs=P(dp, tp),
@@ -84,7 +85,7 @@ def tiled_topk_2d(c_row, c_col, d_row, d_col, mesh: Mesh, k: int,
     dp, tp = axes
 
     @functools.partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(P(dp, None), P(tp, None), P(dp), P(tp)),
         out_specs=(P(dp, None), P(dp, None)),

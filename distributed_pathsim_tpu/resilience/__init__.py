@@ -1,0 +1,64 @@
+"""Fault-tolerant execution layer.
+
+Four pieces, applied at the stack's failure seams (GEXF load, metapath
+compile, backend init, per-tile execute, checkpoint write, multi-host
+rendezvous):
+
+- :mod:`.policy` — :class:`RetryPolicy`: exponential backoff + jitter,
+  exception-class filters, overall deadlines; env-tunable defaults.
+- :mod:`.inject` — :class:`FaultInjector`: a deterministic chaos
+  harness (``PATHSIM_FAULT_PLAN``) that raises, delays, partially
+  writes, or requests preemption at the same seams, so every recovery
+  path runs on CPU in tier-1.
+- :mod:`.degrade` — the graceful step-down chain
+  (jax-sharded → jax → numpy; native loader → python loader).
+- :mod:`.preemption` — SIGTERM/SIGINT → flush in-flight tiles through
+  the CheckpointManager → exit 75 with a resumable manifest.
+
+The one-line integration surface for seams is :func:`resilient_call`.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, TypeVar
+
+from . import inject
+from .degrade import backend_chain, create_backend_resilient
+from .inject import FaultInjector, InjectedCrash, InjectedFault
+from .policy import RetryPolicy, TransientError, policy_from_env
+from .preemption import PREEMPTED_EXIT_CODE, Preempted, handler as preemption_handler
+
+T = TypeVar("T")
+
+__all__ = [
+    "FaultInjector",
+    "InjectedCrash",
+    "InjectedFault",
+    "PREEMPTED_EXIT_CODE",
+    "Preempted",
+    "RetryPolicy",
+    "TransientError",
+    "backend_chain",
+    "create_backend_resilient",
+    "policy_from_env",
+    "preemption_handler",
+    "resilient_call",
+]
+
+
+def resilient_call(
+    seam: str,
+    fn: Callable[[], T],
+    policy: RetryPolicy | None = None,
+) -> T:
+    """Run ``fn`` as one seam attempt: consult the fault injector, then
+    the real work, under ``policy`` (env default when None). Each retry
+    attempt re-fires the injector — an injected fault counts as a
+    failure of the operation itself."""
+    policy = policy or policy_from_env()
+
+    def attempt() -> T:
+        inject.fire(seam)
+        return fn()
+
+    return policy.call(attempt, seam=seam)

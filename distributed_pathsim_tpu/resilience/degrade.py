@@ -1,0 +1,102 @@
+"""Graceful degradation: step down the backend chain instead of dying.
+
+When a backend's init keeps failing transiently even after retries
+(accelerator runtime wedged, device OOM on attach, a native extension
+refusing to load), a long-running query workload (Atrapos framing,
+PAPERS.md) is better served degraded than dead: the sharded backend
+steps down to single-device dense, dense steps down to the numpy
+oracle — slower, but every backend serves the identical primitives, so
+results are unchanged. Each step emits a structured ``degrade`` event;
+``--no-degrade`` (or ``degrade=False``) restores fail-fast behavior.
+
+Degradation triggers ONLY on the retry policy's transient classes: a
+deterministic config error (bad variant, asymmetric metapath on a
+symmetric-only backend) raises immediately on the first backend — a
+chain walk would just mask the user's actual mistake.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..utils.logging import runtime_event
+from . import inject
+from .policy import RetryPolicy, policy_from_env
+
+# name → next step down. Every chain ends at the numpy f64 oracle, which
+# has no device, no jit, and no native code to fail.
+BACKEND_DEGRADATION: dict[str, str] = {
+    "jax-sharded": "jax",
+    "jax-sparse": "jax",
+    "jax": "numpy",
+}
+
+# Options that only one family of backends understands; forwarding them
+# down the chain would either crash the fallback or silently change its
+# math, so they are dropped (with the drop recorded in the event).
+_BACKEND_ONLY_OPTIONS = {
+    "tile_rows": ("jax-sparse",),
+    "n_devices": ("jax-sharded",),
+}
+
+
+def backend_chain(name: str) -> list[str]:
+    """The degradation order starting at ``name`` (inclusive)."""
+    chain = [name]
+    while chain[-1] in BACKEND_DEGRADATION:
+        chain.append(BACKEND_DEGRADATION[chain[-1]])
+    return chain
+
+
+def _options_for(name: str, options: dict[str, Any]) -> dict[str, Any]:
+    return {
+        k: v
+        for k, v in options.items()
+        if k not in _BACKEND_ONLY_OPTIONS or name in _BACKEND_ONLY_OPTIONS[k]
+    }
+
+
+def create_backend_resilient(
+    name: str,
+    hin,
+    metapath,
+    policy: RetryPolicy | None = None,
+    degrade: bool = True,
+    **options: Any,
+):
+    """:func:`..backends.base.create_backend` with retries at the
+    ``backend_init`` seam and, when ``degrade``, the step-down chain."""
+    from ..backends.base import create_backend
+
+    policy = policy or policy_from_env()
+    chain = backend_chain(name) if degrade else [name]
+    last_exc: BaseException | None = None
+    for step, candidate in enumerate(chain):
+        opts = _options_for(candidate, options)
+
+        def attempt(candidate=candidate, opts=opts):
+            inject.fire("backend_init")
+            return create_backend(candidate, hin, metapath, **opts)
+
+        try:
+            backend = policy.call(attempt, seam="backend_init")
+        except policy.retryable as exc:
+            last_exc = exc
+            if candidate == chain[-1]:
+                raise
+            runtime_event(
+                "degrade",
+                component="backend",
+                from_=candidate,
+                to=chain[step + 1],
+                error=repr(exc),
+            )
+            continue
+        if step > 0:
+            runtime_event(
+                "degraded_backend_active",
+                requested=name,
+                active=candidate,
+            )
+        return backend
+    raise last_exc  # pragma: no cover — loop always returns or raises

@@ -1,0 +1,147 @@
+"""Retry policies: exponential backoff + jitter, class filters, deadlines.
+
+The stack's failure seams (GEXF load, metapath compile, backend init,
+per-tile execute, checkpoint I/O, multi-host rendezvous) all share one
+failure taxonomy: *transient* faults — a flaky native loader, a rejected
+remote compile (the HTTP 413 incident in git history), a preempted host,
+a full-then-freed disk — deserve a bounded, backed-off retry; *semantic*
+faults (bad metapath, wrong checkpoint directory) must surface on the
+first attempt. :class:`RetryPolicy` encodes that split once so every
+seam behaves identically and every retry is visible as a structured
+``runtime_event``.
+
+Defaults come from the environment so an operator can harden a flaky
+deployment without touching call sites::
+
+    PATHSIM_MAX_RETRIES=5 PATHSIM_RETRY_BASE_DELAY=0.2 dpathsim ...
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import random
+import time
+from typing import Callable, TypeVar
+
+from ..utils.logging import runtime_event
+
+T = TypeVar("T")
+
+
+class TransientError(Exception):
+    """A failure worth retrying: the operation may succeed if repeated.
+
+    Raised directly by the fault injector and usable by any subsystem
+    that can classify its own failures (e.g. a remote compile service
+    returning a retryable status)."""
+
+
+# What a retry can plausibly fix. ValueError/KeyError (user input,
+# schema mismatches) are deliberately absent: retrying a deterministic
+# error just triples its latency.
+DEFAULT_RETRYABLE: tuple[type[BaseException], ...] = (
+    TransientError,
+    OSError,
+    ConnectionError,
+    TimeoutError,
+)
+
+# Jitter is deterministic by default (seeded RNG): chaos runs and tests
+# reproduce byte-for-byte. Operators fighting thundering herds across a
+# pod set PATHSIM_RETRY_SEED to the process rank (or any varying value).
+_rng = random.Random(int(os.environ.get("PATHSIM_RETRY_SEED", "0")))
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded exponential backoff with jitter and an overall deadline.
+
+    ``max_attempts`` counts the first try: 3 means one try + two
+    retries. ``deadline_s`` bounds the *total* time spent inside
+    :meth:`call` — an attempt whose next backoff would overrun the
+    deadline is not slept for; the last error raises instead.
+    """
+
+    max_attempts: int = 3
+    base_delay: float = 0.05
+    max_delay: float = 2.0
+    multiplier: float = 2.0
+    jitter: float = 0.25  # ± fraction of the nominal delay
+    deadline_s: float | None = None
+    retryable: tuple[type[BaseException], ...] = DEFAULT_RETRYABLE
+    non_retryable: tuple[type[BaseException], ...] = ()
+
+    def replace(self, **changes) -> "RetryPolicy":
+        return dataclasses.replace(self, **changes)
+
+    def backoff(self, attempt: int) -> float:
+        """Nominal delay after the ``attempt``-th failure (1-based),
+        before jitter."""
+        return min(
+            self.base_delay * self.multiplier ** (attempt - 1), self.max_delay
+        )
+
+    def _jittered(self, delay: float) -> float:
+        if self.jitter <= 0:
+            return delay
+        return delay * (1.0 + self.jitter * (2.0 * _rng.random() - 1.0))
+
+    def call(self, fn: Callable[[], T], seam: str = "") -> T:
+        """Run ``fn`` under this policy. Non-retryable and unknown
+        exception classes propagate immediately; retryable ones are
+        retried with backoff until attempts or the deadline run out."""
+        if self.max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        t0 = time.monotonic()
+        for attempt in range(1, self.max_attempts + 1):
+            try:
+                return fn()
+            except self.non_retryable:
+                raise
+            except self.retryable as exc:
+                if attempt >= self.max_attempts:
+                    raise
+                delay = self._jittered(self.backoff(attempt))
+                if (
+                    self.deadline_s is not None
+                    and time.monotonic() - t0 + delay > self.deadline_s
+                ):
+                    runtime_event(
+                        "retry_deadline",
+                        seam=seam,
+                        attempt=attempt,
+                        deadline_s=self.deadline_s,
+                        error=repr(exc),
+                    )
+                    raise
+                runtime_event(
+                    "retry",
+                    seam=seam,
+                    attempt=attempt,
+                    max_attempts=self.max_attempts,
+                    delay_s=round(delay, 4),
+                    error=repr(exc),
+                )
+                time.sleep(delay)
+        raise AssertionError("unreachable")  # pragma: no cover
+
+
+def _env_float(name: str, default: float | None) -> float | None:
+    raw = os.environ.get(name)
+    if raw is None or not raw.strip():
+        return default
+    return float(raw)
+
+
+def policy_from_env(**overrides) -> RetryPolicy:
+    """The environment-tuned default policy; ``overrides`` win over env,
+    env wins over the dataclass defaults."""
+    fields = {
+        "max_attempts": int(os.environ.get("PATHSIM_MAX_RETRIES", "3")),
+        "base_delay": _env_float("PATHSIM_RETRY_BASE_DELAY", 0.05),
+        "max_delay": _env_float("PATHSIM_RETRY_MAX_DELAY", 2.0),
+        "deadline_s": _env_float("PATHSIM_RETRY_DEADLINE", None),
+    }
+    fields.update({k: v for k, v in overrides.items() if v is not None})
+    return RetryPolicy(**fields)

@@ -1,0 +1,63 @@
+"""JAX version compatibility shims.
+
+The sharded paths are written against the modern ``jax.shard_map``
+surface (``check_vma=``). Older JAX (≤ 0.4.x) only ships
+``jax.experimental.shard_map.shard_map`` with the pre-rename
+``check_rep=`` argument — same semantics, different spelling. Every
+shard_map call in this repo routes through :func:`shard_map` so a JAX
+upgrade (or downgrade on a TPU image pinned to an older wheel) degrades
+to the available API instead of dying with ``AttributeError`` at import
+of the first sharded module.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+
+@functools.cache
+def _impl():
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map, "check_vma"
+    from jax.experimental.shard_map import shard_map as sm
+
+    return sm, "check_rep"
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` on new JAX, ``jax.experimental.shard_map`` (with
+    ``check_vma`` translated to ``check_rep``) on old."""
+    impl, vma_kwarg = _impl()
+    return impl(
+        f,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        **{vma_kwarg: check_vma},
+    )
+
+
+def axis_size(axis_name) -> int:
+    """Static size of a shard_map mesh axis. Old JAX has no
+    ``lax.axis_size``; ``psum`` of a Python literal constant-folds to a
+    Python int there, which is exactly the static value the ring setup
+    code (permutation tables, loop bounds) needs."""
+    import jax.lax
+
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+def pcast(x, axis_names, to: str = "varying"):
+    """``lax.pcast`` marks values for the varying-axis (VMA) checker.
+    Old JAX has neither the primitive nor the checker (its ``check_rep``
+    machinery infers replication itself), so the declaration is simply
+    dropped there."""
+    import jax.lax
+
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, axis_names, to=to)
+    return x

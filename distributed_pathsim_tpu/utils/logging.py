@@ -28,6 +28,38 @@ import sys
 import time
 from typing import IO, Any
 
+# -- runtime events (resilience channel) -----------------------------------
+#
+# Retries, degradations, fault injections, and preemption requests must be
+# VISIBLE — a run that silently stepped down from the sharded backend to
+# numpy is a debugging trap. Every such event goes through runtime_event():
+# one structured line on stderr (never stdout — the reference grammar owns
+# stdout), plus the JSONL metrics channel when a RunLogger is registered
+# as the process-wide sink (the CLI registers its logger for the run).
+
+_EVENT_SINK: "RunLogger | None" = None
+
+
+def set_event_sink(logger: "RunLogger | None") -> None:
+    """Register (or clear, with None) the RunLogger whose JSONL metrics
+    channel receives runtime events."""
+    global _EVENT_SINK
+    _EVENT_SINK = logger
+
+
+def runtime_event(event: str, **fields: Any) -> None:
+    """Emit one structured resilience/runtime event.
+
+    stderr rendering: ``[pathsim:EVENT] k=v k=v``; machine rendering: a
+    metrics-JSONL record ``{"event": EVENT, ...fields}`` on the
+    registered sink. Values are stringified for stderr but passed
+    through for JSONL (callers pre-repr exceptions)."""
+    rendered = " ".join(f"{k}={v}" for k, v in fields.items())
+    print(f"[pathsim:{event}] {rendered}".rstrip(), file=sys.stderr)
+    sink = _EVENT_SINK
+    if sink is not None:
+        sink.metric(event=event, **fields)
+
 
 class RunLogger:
     """Dual-channel logger: reference-grammar text + optional JSONL."""

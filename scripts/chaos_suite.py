@@ -1,0 +1,109 @@
+"""Chaos suite: the tier-1 tests under a fixed fault-injection schedule.
+
+Two passes (``make chaos`` runs both):
+
+1. **Targeted** — the ``chaos``-marked recovery tests with their own
+   per-test plans (fast; these also run in plain tier-1).
+2. **Ambient** — the FULL tier-1 suite with ``PATHSIM_FAULT_PLAN``
+   injecting transient failures at every retried seam. The suite must
+   still pass: retries are supposed to make one-off seam failures
+   invisible to every caller. Any test that breaks under the ambient
+   plan has found code that touches a seam without going through the
+   resilience layer.
+
+The schedule is FIXED (deterministic rules, deterministic jitter via
+PATHSIM_RETRY_SEED): a chaos failure reproduces by re-running this
+script, not by chasing a random seed.
+
+Usage::
+
+    python scripts/chaos_suite.py            # both passes
+    python scripts/chaos_suite.py --ambient  # ambient pass only
+    python scripts/chaos_suite.py --targeted # targeted pass only
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+# One transient failure at every retried seam, plus a torn checkpoint
+# write and a slow backend init. Counts are small on purpose: each rule
+# is consumed by the first tests that cross its seam, proving recovery
+# there; the rest of the suite then runs clean.
+AMBIENT_PLAN = ",".join(
+    [
+        "gexf_load:error:1",
+        "metapath_compile:error:1",
+        "backend_init:error:1",
+        "backend_init:delay:1:0.05",
+        "tile_execute:error:2",
+        "device_execute:error:1",
+        "checkpoint_write:error:1",
+        "checkpoint_write:partial:1",
+        "multihost_init:error:1",
+    ]
+)
+
+BASE_ARGS = [
+    "-m",
+    "pytest",
+    "tests/",
+    "-q",
+    "--continue-on-collection-errors",
+    "-p",
+    "no:cacheprovider",
+    "-p",
+    "no:xdist",
+    "-p",
+    "no:randomly",
+]
+
+
+def _run(label: str, pytest_args: list[str], extra_env: dict) -> int:
+    env = os.environ.copy()
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    # fast, deterministic backoff — chaos runs should not sleep
+    env.setdefault("PATHSIM_RETRY_BASE_DELAY", "0.001")
+    env.setdefault("PATHSIM_RETRY_SEED", "0")
+    env.update(extra_env)
+    print(f"== chaos_suite: {label} ==", flush=True)
+    rc = subprocess.call(
+        [sys.executable, *BASE_ARGS, *pytest_args], cwd=str(REPO), env=env
+    )
+    print(f"== chaos_suite: {label} -> exit {rc} ==", flush=True)
+    return rc
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    group = ap.add_mutually_exclusive_group()
+    group.add_argument("--ambient", action="store_true",
+                       help="ambient pass only")
+    group.add_argument("--targeted", action="store_true",
+                       help="targeted pass only")
+    args = ap.parse_args(argv)
+
+    rc = 0
+    if not args.ambient:
+        rc |= _run(
+            "targeted (chaos-marked tests, per-test plans)",
+            ["-m", "chaos and not slow"],
+            {},
+        )
+    if not args.targeted:
+        rc |= _run(
+            "ambient (full tier-1 under PATHSIM_FAULT_PLAN)",
+            ["-m", "not slow"],
+            {"PATHSIM_FAULT_PLAN": AMBIENT_PLAN},
+        )
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

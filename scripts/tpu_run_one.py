@@ -51,7 +51,10 @@ def main(argv=None) -> int:
     ap.add_argument("child", nargs=argparse.REMAINDER,
                     help="-- then the child argv (script or -m module)")
     args = ap.parse_args(argv)
-    child = [a for a in args.child if a != "--"] or None
+    # Strip only the leading "--" separator: a child argv that itself
+    # contains a literal "--" (forwarding args through a nested
+    # argparse) must receive it intact (ADVICE r5).
+    child = args.child[1:] if args.child[:1] == ["--"] else args.child
     if not child:
         ap.error("pass the child argv after --")
 

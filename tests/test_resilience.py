@@ -1,0 +1,432 @@
+"""Fault-tolerant execution layer: retry policies, chaos injection,
+degradation, preemption, and checkpoint-resume under injected failure.
+
+Everything here runs on CPU in tier-1: the FaultInjector makes every
+recovery path deterministic. Tests marked ``chaos`` form the fixed
+schedule ``scripts/chaos_suite.py`` re-runs under a global fault plan.
+"""
+
+import os
+import signal
+
+import numpy as np
+import pytest
+
+from distributed_pathsim_tpu import resilience
+from distributed_pathsim_tpu.backends.base import create_backend
+from distributed_pathsim_tpu.config import RunConfig
+from distributed_pathsim_tpu.data.synthetic import synthetic_hin, write_gexf
+from distributed_pathsim_tpu.driver import PathSimDriver
+from distributed_pathsim_tpu.engine import build, load_dataset
+from distributed_pathsim_tpu.ops.metapath import compile_metapath
+from distributed_pathsim_tpu.resilience import (
+    InjectedCrash,
+    InjectedFault,
+    Preempted,
+    RetryPolicy,
+    TransientError,
+    inject,
+)
+from distributed_pathsim_tpu.resilience.preemption import handler as preemption_handler
+from distributed_pathsim_tpu.utils.checkpoint import CheckpointManager
+
+
+@pytest.fixture
+def faults(monkeypatch):
+    """Install an explicit fault plan (isolated from the environment)
+    with near-zero backoff; always reset afterwards."""
+    monkeypatch.setenv("PATHSIM_RETRY_BASE_DELAY", "0.001")
+    yield inject.install_plan
+    inject.reset()
+
+
+@pytest.fixture
+def preemption():
+    yield preemption_handler
+    preemption_handler.uninstall()
+    preemption_handler.reset()
+
+
+@pytest.fixture(scope="module")
+def hin():
+    return synthetic_hin(120, 200, 12, seed=3)
+
+
+@pytest.fixture(scope="module")
+def mp(hin):
+    return compile_metapath("APVPA", hin.schema)
+
+
+@pytest.fixture(scope="module")
+def clean_topk(hin, mp):
+    d = PathSimDriver(create_backend("jax-sparse", hin, mp, tile_rows=16))
+    return d.rank_all(k=5)
+
+
+@pytest.fixture(scope="module")
+def gexf_path(tmp_path_factory):
+    h = synthetic_hin(48, 80, 6, seed=7, materialize_ids=True)
+    p = tmp_path_factory.mktemp("data") / "tiny.gexf"
+    write_gexf(h, str(p))
+    return str(p)
+
+
+# -- RetryPolicy -----------------------------------------------------------
+
+
+def test_retry_succeeds_after_transients():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise TransientError("flap")
+        return "ok"
+
+    policy = RetryPolicy(max_attempts=3, base_delay=0.0, jitter=0.0)
+    assert policy.call(flaky, seam="t") == "ok"
+    assert len(calls) == 3
+
+
+def test_retry_exhausts_and_raises_last_error():
+    policy = RetryPolicy(max_attempts=2, base_delay=0.0)
+    calls = []
+
+    def always():
+        calls.append(1)
+        raise TransientError("still down")
+
+    with pytest.raises(TransientError, match="still down"):
+        policy.call(always)
+    assert len(calls) == 2
+
+
+def test_non_retryable_and_unknown_classes_raise_immediately():
+    policy = RetryPolicy(
+        max_attempts=5, base_delay=0.0, non_retryable=(InjectedCrash,)
+    )
+    calls = []
+
+    def crash():
+        calls.append(1)
+        raise InjectedCrash("dead")
+
+    with pytest.raises(InjectedCrash):
+        policy.call(crash)
+    assert len(calls) == 1  # filtered by non_retryable
+
+    def semantic():
+        calls.append(1)
+        raise ValueError("bad input")
+
+    calls.clear()
+    with pytest.raises(ValueError):
+        policy.call(semantic)
+    assert len(calls) == 1  # not in retryable at all
+
+
+def test_backoff_is_exponential_and_capped():
+    policy = RetryPolicy(base_delay=0.1, multiplier=2.0, max_delay=0.5)
+    assert [policy.backoff(a) for a in (1, 2, 3, 4, 5)] == [
+        0.1, 0.2, 0.4, 0.5, 0.5,
+    ]
+
+
+def test_deadline_stops_retrying():
+    policy = RetryPolicy(
+        max_attempts=100, base_delay=10.0, jitter=0.0, deadline_s=0.01
+    )
+    calls = []
+
+    def always():
+        calls.append(1)
+        raise TransientError("down")
+
+    with pytest.raises(TransientError):
+        policy.call(always)
+    assert len(calls) == 1  # the first backoff would overrun the deadline
+
+
+def test_policy_from_env(monkeypatch):
+    monkeypatch.setenv("PATHSIM_MAX_RETRIES", "7")
+    monkeypatch.setenv("PATHSIM_RETRY_BASE_DELAY", "0.25")
+    p = resilience.policy_from_env()
+    assert p.max_attempts == 7 and p.base_delay == 0.25
+    assert resilience.policy_from_env(max_attempts=2).max_attempts == 2
+
+
+# -- FaultInjector ---------------------------------------------------------
+
+
+def test_plan_parsing():
+    rules = inject.parse_plan(
+        "tile_execute:crash:1@2, checkpoint_write:partial , "
+        "backend_init:delay:2:0.5"
+    )
+    assert [(r.seam, r.kind, r.count, r.skip, r.arg) for r in rules] == [
+        ("tile_execute", "crash", 1, 2, None),
+        ("checkpoint_write", "partial", 1, 0, None),
+        ("backend_init", "delay", 2, 0, 0.5),
+    ]
+
+
+@pytest.mark.parametrize("bad", ["tile_execute", "x:frobnicate", "a:error:NaN"])
+def test_plan_rejects_malformed_entries(bad):
+    with pytest.raises(ValueError):
+        inject.parse_plan(bad)
+
+
+def test_injector_skip_then_fire(faults):
+    inj = faults("tile_execute:error:2@1")
+    inj.fire("tile_execute")  # skipped
+    with pytest.raises(InjectedFault):
+        inj.fire("tile_execute")
+    with pytest.raises(InjectedFault):
+        inj.fire("tile_execute")
+    inj.fire("tile_execute")  # budget exhausted
+    assert inj.hits["tile_execute"] == 4
+    assert not inj.active
+
+
+# -- seams -----------------------------------------------------------------
+
+
+def test_missing_dataset_fails_fast(faults):
+    """A missing file is deterministic: no retries, no bogus
+    loader-degrade event — straight to the CLI's clean error.
+    (FileNotFoundError from the Python reader; the native parser
+    reports it as a ValueError — both are non-retryable.)"""
+    inj = faults("")
+    with pytest.raises((FileNotFoundError, ValueError), match="nope.gexf"):
+        load_dataset("/nonexistent/nope.gexf")
+    assert inj.hits.get("gexf_load", 0) <= 2  # one pass per read path
+    assert inj.events == []
+
+
+def test_cli_max_retries_reaches_deep_seams(faults, gexf_path, monkeypatch):
+    """--max-retries 1 must disable retries at the tile seam too (the
+    flag is exported to the env the deep seams read)."""
+    from distributed_pathsim_tpu import cli
+
+    monkeypatch.setenv("PATHSIM_MAX_RETRIES", "3")  # restored at teardown
+    faults("tile_execute:error:1")
+    with pytest.raises(InjectedFault):
+        cli.main([
+            "--dataset", gexf_path, "--backend", "jax-sparse",
+            "--tile-rows", "16", "--top-k", "3", "--quiet",
+            "--max-retries", "1",
+        ])
+
+
+@pytest.mark.chaos
+def test_load_seam_retries_and_succeeds(faults, gexf_path):
+    inj = faults("gexf_load:error:1")
+    h = load_dataset(gexf_path)
+    assert h.type_size("author") == 48
+    assert [e["seam"] for e in inj.events] == ["gexf_load"]
+
+
+@pytest.mark.chaos
+def test_tile_seam_injection_is_absorbed(faults, hin, mp, clean_topk):
+    faults("tile_execute:error:2")
+    d = PathSimDriver(create_backend("jax-sparse", hin, mp, tile_rows=16))
+    v, i = d.rank_all(k=5)
+    np.testing.assert_array_equal(v, clean_topk[0])
+    np.testing.assert_array_equal(i, clean_topk[1])
+
+
+def test_backend_chain_order():
+    assert resilience.backend_chain("jax-sharded") == [
+        "jax-sharded", "jax", "numpy",
+    ]
+    assert resilience.backend_chain("jax-sparse") == ["jax-sparse", "jax", "numpy"]
+    assert resilience.backend_chain("numpy") == ["numpy"]
+
+
+@pytest.mark.chaos
+def test_backend_init_degrades_down_the_chain(faults, hin, mp):
+    # 3 attempts fail on jax-sharded (default policy = 3), the 4th fire
+    # (first jax attempt) succeeds.
+    faults("backend_init:error:3")
+    b = resilience.create_backend_resilient("jax-sharded", hin, mp, n_devices=8)
+    assert b.name == "jax"
+
+
+def test_no_degrade_fails_fast(faults, hin, mp):
+    faults("backend_init:error:99")
+    with pytest.raises(InjectedFault):
+        resilience.create_backend_resilient("jax", hin, mp, degrade=False)
+
+
+def test_degradation_does_not_mask_semantic_errors(faults, hin):
+    # An asymmetric metapath is a user error on jax-sparse; it must
+    # raise, not silently degrade to a backend that would accept it.
+    faults("")
+    apv = compile_metapath("APV", hin.schema)
+    with pytest.raises(ValueError, match="symmetric"):
+        resilience.create_backend_resilient("jax-sparse", hin, apv)
+
+
+# -- checkpoint I/O --------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_partial_write_retried_and_atomic(faults, tmp_path):
+    inj = faults("checkpoint_write:partial:1")
+    ck = CheckpointManager(str(tmp_path / "ck"))
+    arr = np.arange(12.0).reshape(3, 4)
+    ck.save_unit("u0", vals=arr)
+    assert [e["kind"] for e in inj.events] == ["partial"]
+    np.testing.assert_array_equal(ck.load_unit("u0")["vals"], arr)
+    leftovers = [p for p in (tmp_path / "ck").iterdir() if ".tmp" in p.name]
+    assert leftovers == []
+
+
+def test_partial_write_exhaustion_never_corrupts(faults, tmp_path):
+    faults("checkpoint_write:partial:9")
+    ck = CheckpointManager(str(tmp_path / "ck"))
+    with pytest.raises(InjectedFault):
+        ck.save_unit("u0", vals=np.ones(4))
+    assert ck.done_keys() == []  # manifest never referenced the unit
+    ck2 = CheckpointManager(str(tmp_path / "ck"))
+    assert ck2.done_keys() == []
+
+
+# -- crash / resume (the reference's own failure mode, generalized) --------
+
+
+@pytest.mark.chaos
+def test_midtile_crash_resume_is_exact_and_skips_done_units(
+    faults, hin, mp, tmp_path, clean_topk
+):
+    """Kill the run at tile 5 of 8, restart, and require (a) identical
+    final scores to the uninterrupted run and (b) that completed tiles
+    were NOT recomputed."""
+    ckdir = str(tmp_path / "ck")
+    faults("tile_execute:crash:1@5")
+    d = PathSimDriver(create_backend("jax-sparse", hin, mp, tile_rows=16))
+    with pytest.raises(InjectedCrash):
+        d.rank_all(k=5, checkpoint_dir=ckdir)
+    done_after_crash = CheckpointManager(ckdir).done_keys()
+    # tiles 0-4 ran; the in-flight pipeline is flushed on the way out
+    assert len(done_after_crash) == 5
+
+    inj = faults("")  # no faults now, but fires still count
+    d2 = PathSimDriver(create_backend("jax-sparse", hin, mp, tile_rows=16))
+    v, i = d2.rank_all(k=5, checkpoint_dir=ckdir)
+    np.testing.assert_array_equal(v, clean_topk[0])
+    np.testing.assert_array_equal(i, clean_topk[1])
+    assert inj.hits.get("tile_execute", 0) == 8 - len(done_after_crash)
+
+
+# -- preemption ------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_preemption_flushes_and_resumes_exactly(
+    faults, preemption, hin, mp, tmp_path, clean_topk
+):
+    ckdir = str(tmp_path / "ck")
+    faults("tile_execute:preempt:1@2")
+    d = PathSimDriver(create_backend("jax-sparse", hin, mp, tile_rows=16))
+    with pytest.raises(Preempted) as exc_info:
+        d.rank_all(k=5, checkpoint_dir=ckdir)
+    assert exc_info.value.resumable
+    assert exc_info.value.checkpoint_dir == ckdir
+    # everything dispatched before the preemption point is durable
+    assert len(CheckpointManager(ckdir).done_keys()) >= 2
+
+    preemption.reset()
+    faults("")
+    d2 = PathSimDriver(create_backend("jax-sparse", hin, mp, tile_rows=16))
+    v, i = d2.rank_all(k=5, checkpoint_dir=ckdir)
+    np.testing.assert_array_equal(v, clean_topk[0])
+    np.testing.assert_array_equal(i, clean_topk[1])
+
+
+@pytest.mark.chaos
+def test_ring_preemption_flushes_and_resumes(faults, preemption, hin, mp, tmp_path):
+    """The sharded ring's stepwise pass honors preemption at step
+    boundaries and resumes exactly, like the jax-sparse tile loop."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    ckdir = str(tmp_path / "ring_ck")
+    b = create_backend("jax-sharded", hin, mp, n_devices=8)
+    want_v, want_i = b.topk(k=5)
+    faults("tile_execute:preempt:1@2")
+    with pytest.raises(Preempted) as exc_info:
+        b.topk_scores(k=5, checkpoint_dir=ckdir)
+    assert exc_info.value.resumable
+
+    preemption.reset()
+    faults("")
+    b2 = create_backend("jax-sharded", hin, mp, n_devices=8)
+    v, i = b2.topk_scores(k=5, checkpoint_dir=ckdir)
+    np.testing.assert_allclose(v, want_v, atol=1e-6)
+    np.testing.assert_array_equal(i, want_i)
+
+
+def test_sigterm_latches_flag(preemption):
+    assert preemption.install()
+    os.kill(os.getpid(), signal.SIGTERM)
+    assert preemption.requested()
+    # a second signal escalates so a stuck drain can be aborted
+    with pytest.raises(KeyboardInterrupt):
+        os.kill(os.getpid(), signal.SIGTERM)
+
+
+def test_preempted_without_checkpoint_is_not_resumable(preemption):
+    preemption.request(reason="test")
+    with pytest.raises(Preempted) as exc_info:
+        preemption.check(checkpoint_dir=None)
+    assert not exc_info.value.resumable
+
+
+# -- the acceptance scenario: one transient failure per seam ---------------
+
+
+@pytest.mark.chaos
+def test_full_run_with_a_fault_at_every_seam(faults, gexf_path, tmp_path):
+    """With PATHSIM_FAULT_PLAN injecting one transient failure per seam,
+    a full small-graph run completes with correct top-k output and logs
+    each recovery event."""
+    clean = build(RunConfig(dataset=gexf_path, backend="jax-sparse",
+                            tile_rows=16, echo=False))[3].rank_all(k=5)
+
+    inj = faults(
+        "gexf_load:error:1,metapath_compile:error:1,backend_init:error:1,"
+        "tile_execute:error:1,checkpoint_write:partial:1,device_execute:error:1"
+    )
+    _, _, backend, driver = build(
+        RunConfig(dataset=gexf_path, backend="jax-sparse", tile_rows=16,
+                  echo=False)
+    )
+    assert backend.name == "jax-sparse"  # retried, NOT degraded
+    v, i = driver.rank_all(k=5, checkpoint_dir=str(tmp_path / "ck"))
+    np.testing.assert_array_equal(v, clean[0])
+    np.testing.assert_array_equal(i, clean[1])
+    seams_hit = {e["seam"] for e in inj.events}
+    assert {"gexf_load", "metapath_compile", "backend_init",
+            "tile_execute", "checkpoint_write"} <= seams_hit
+
+
+@pytest.mark.chaos
+def test_cli_preempted_exit_code_and_resume(faults, gexf_path, tmp_path, capsys):
+    from distributed_pathsim_tpu import cli
+    from distributed_pathsim_tpu.resilience.preemption import PREEMPTED_EXIT_CODE
+
+    ckdir = str(tmp_path / "ck")
+    rank_argv = [
+        "--dataset", gexf_path, "--backend", "jax-sparse", "--tile-rows", "16",
+        "--top-k", "3", "--checkpoint-dir", ckdir, "--quiet",
+    ]
+    faults("tile_execute:preempt:1@1")
+    assert cli.main(rank_argv) == PREEMPTED_EXIT_CODE
+    assert "preempted" in capsys.readouterr().err
+
+    faults("")
+    assert cli.main(rank_argv) == 0
+    out = capsys.readouterr().out
+    assert "Ranked top-3" in out
