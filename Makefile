@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: test chaos
+.PHONY: test chaos serve-smoke
 
 # Tier-1: the fast CPU suite (the driver's acceptance gate).
 test:
@@ -15,3 +15,10 @@ test:
 # PATHSIM_FAULT_PLAN injecting one transient failure per seam.
 chaos:
 	$(PYTHON) scripts/chaos_suite.py
+
+# Serving smoke: the closed-loop load generator on a small fixed-seed
+# synthetic graph, with hard gates (warm-cache p50 < cold-cache p50,
+# zero shed events). The same run is wired as a non-slow pytest
+# (tests/test_serving.py::test_bench_serving_smoke), so tier-1 covers it.
+serve-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) bench_serving.py --smoke

@@ -1,0 +1,255 @@
+"""Closed-loop load generator for the online serving layer.
+
+Measures what the serving PR claims, on one synthetic graph with a
+fixed seed:
+
+- **serial**: per-row dispatch (max_batch=1, caches off) — the
+  pre-serving baseline every query used to pay;
+- **cold**: coalesced batched dispatch (bucket ladder up to
+  ``--max-batch``), caches off — what batching alone buys;
+- **warm**: full multi-tier cache, hot working set — what the cache
+  tiers buy on a repeated-query workload (Atrapos's observation);
+- **mixed**: 50% hot / 50% cold-miss traffic — the honest in-between.
+
+Each regime runs C closed-loop clients (every client issues its next
+query only after the previous answer returns — QPS is an output, not an
+input), reports QPS and p50/p95/p99 latency, and the JSON artifact
+carries the service's own stats (bucket histogram, cache hit rates,
+shed count) so a reported speedup can be cross-checked against what the
+pipeline actually did.
+
+``--smoke`` is the tier-1 wiring: a small graph, short runs, and two
+hard assertions — warm-cache p50 < cold-cache p50, and zero shed
+events — exercised by ``make serve-smoke`` and a non-slow pytest.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+
+import numpy as np
+
+
+def _percentiles(lat_s: list[float]) -> dict:
+    a = np.asarray(sorted(lat_s))
+    return {
+        "p50_ms": round(float(np.percentile(a, 50)) * 1e3, 4),
+        "p95_ms": round(float(np.percentile(a, 95)) * 1e3, 4),
+        "p99_ms": round(float(np.percentile(a, 99)) * 1e3, 4),
+        "mean_ms": round(float(a.mean()) * 1e3, 4),
+    }
+
+
+def _run_clients(service, schedule: list[list[int]], k: int) -> dict:
+    """Closed-loop: client c issues schedule[c] row queries back to
+    back. Returns QPS + latency percentiles + shed count."""
+    from distributed_pathsim_tpu.serving import LoadShedError
+
+    lats: list[list[float]] = [[] for _ in schedule]
+    shed = [0]
+    barrier = threading.Barrier(len(schedule) + 1)
+
+    def client(ci: int, rows: list[int]) -> None:
+        barrier.wait()
+        for r in rows:
+            t0 = time.perf_counter()
+            try:
+                service.topk_index(int(r), k=k)
+            except LoadShedError:
+                shed[0] += 1
+                continue
+            lats[ci].append(time.perf_counter() - t0)
+
+    threads = [
+        threading.Thread(target=client, args=(ci, rows), daemon=True)
+        for ci, rows in enumerate(schedule)
+    ]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    t0 = time.perf_counter()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    flat = [x for sub in lats for x in sub]
+    return {
+        "queries": len(flat),
+        "wall_s": round(wall, 4),
+        "qps": round(len(flat) / wall, 2) if wall > 0 else float("inf"),
+        "shed": shed[0],
+        **_percentiles(flat),
+    }
+
+
+def _build_service(hin, backend_name, max_batch, max_wait_ms, caches,
+                   queue_depth=4096, warm=True, k=10):
+    from distributed_pathsim_tpu.backends.base import create_backend
+    from distributed_pathsim_tpu.ops.metapath import compile_metapath
+    from distributed_pathsim_tpu.serving import PathSimService, ServeConfig
+
+    mp = compile_metapath("APVPA", hin.schema)
+    backend = create_backend(backend_name, hin, mp)
+    return PathSimService(
+        backend,
+        config=ServeConfig(
+            max_batch=max_batch,
+            max_wait_ms=max_wait_ms,
+            queue_depth=queue_depth,
+            cache_entries=4096 if caches else 0,
+            tile_cache_bytes=(64 << 20) if caches else 0,
+            k_default=k,
+            warm=warm,
+        ),
+    )
+
+
+def run_bench(
+    n_authors: int = 2048,
+    n_papers: int = 4096,
+    n_venues: int = 48,
+    clients: int = 32,
+    queries_per_client: int = 64,
+    max_batch: int = 32,
+    max_wait_ms: float = 2.0,
+    k: int = 10,
+    backend: str = "jax",
+    seed: int = 0,
+) -> dict:
+    from distributed_pathsim_tpu.data.synthetic import synthetic_hin
+
+    hin = synthetic_hin(n_authors, n_papers, n_venues, seed=seed)
+    rng = np.random.default_rng(seed)
+    n = hin.type_size("author")
+    total = clients * queries_per_client
+
+    # Workloads. Cold/serial: every query a distinct-ish uniform row
+    # (caches are OFF for those regimes anyway, so reuse wouldn't help).
+    # Warm/mixed: a small Zipf-hot working set, pre-touched, so warm
+    # traffic is pure cache and mixed is half-and-half.
+    uniform = rng.integers(0, n, size=(clients, queries_per_client))
+    hot_set = rng.choice(n, size=max(8, n // 64), replace=False)
+    hot = rng.choice(hot_set, size=(clients, queries_per_client))
+    mixed = np.where(
+        rng.random((clients, queries_per_client)) < 0.5,
+        hot,
+        rng.integers(0, n, size=(clients, queries_per_client)),
+    )
+
+    out: dict = {
+        "graph": {"authors": n, "papers": n_papers, "venues": n_venues,
+                  "seed": seed},
+        "load": {"clients": clients,
+                 "queries_per_client": queries_per_client,
+                 "total_queries": total, "k": k,
+                 "max_batch": max_batch, "max_wait_ms": max_wait_ms},
+        "backend": backend,
+        "regimes": {},
+    }
+
+    # -- serial baseline: per-row dispatch, no coalescing, no cache ----
+    svc = _build_service(hin, backend, max_batch=1, max_wait_ms=0.0,
+                         caches=False, k=k)
+    out["regimes"]["serial"] = _run_clients(svc, uniform.tolist(), k)
+    out["regimes"]["serial"]["service"] = svc.stats()["dispatch"]
+    svc.close()
+
+    # -- cold: coalesced/batched dispatch, caches still off ------------
+    svc = _build_service(hin, backend, max_batch=max_batch,
+                         max_wait_ms=max_wait_ms, caches=False, k=k)
+    out["regimes"]["cold"] = _run_clients(svc, uniform.tolist(), k)
+    out["regimes"]["cold"]["service"] = svc.stats()["dispatch"]
+    svc.close()
+
+    # -- warm: caches on, hot working set pre-touched ------------------
+    svc = _build_service(hin, backend, max_batch=max_batch,
+                         max_wait_ms=max_wait_ms, caches=True, k=k)
+    for r in hot_set:
+        svc.topk_index(int(r), k=k)
+    out["regimes"]["warm"] = _run_clients(svc, hot.tolist(), k)
+    warm_stats = svc.stats()
+    out["regimes"]["warm"]["service"] = warm_stats["dispatch"]
+    out["regimes"]["warm"]["cache"] = warm_stats["result_cache"]
+
+    # -- mixed: 50% hot / 50% uniform on the SAME warm service ---------
+    out["regimes"]["mixed"] = _run_clients(svc, mixed.tolist(), k)
+    mixed_stats = svc.stats()
+    out["regimes"]["mixed"]["service"] = mixed_stats["dispatch"]
+    out["regimes"]["mixed"]["cache"] = mixed_stats["result_cache"]
+    svc.close()
+
+    r = out["regimes"]
+    out["speedups"] = {
+        "batched_vs_serial_qps": round(
+            r["cold"]["qps"] / r["serial"]["qps"], 2
+        ),
+        "warm_vs_cold_qps": round(r["warm"]["qps"] / r["cold"]["qps"], 2),
+        "mixed_vs_cold_qps": round(r["mixed"]["qps"] / r["cold"]["qps"], 2),
+    }
+    return out
+
+
+def run_smoke(out_path: str | None = None) -> dict:
+    """Small fixed-seed run with the two hard gates tier-1 enforces."""
+    result = run_bench(
+        n_authors=384, n_papers=640, n_venues=12,
+        clients=8, queries_per_client=24,
+        max_batch=8, max_wait_ms=2.0, k=5,
+    )
+    r = result["regimes"]
+    checks = {
+        "warm_p50_lt_cold_p50": r["warm"]["p50_ms"] < r["cold"]["p50_ms"],
+        "zero_shed": all(
+            reg["shed"] == 0 and reg["service"]["shed"] == 0
+            for reg in r.values()
+        ),
+    }
+    result["smoke_checks"] = checks
+    if out_path:
+        with open(out_path, "w", encoding="utf-8") as f:
+            json.dump(result, f, indent=2)
+    if not all(checks.values()):
+        raise AssertionError(f"serve smoke failed: {checks}")
+    return result
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--smoke", action="store_true",
+                   help="small fixed run with hard pass/fail gates")
+    p.add_argument("--authors", type=int, default=2048)
+    p.add_argument("--papers", type=int, default=4096)
+    p.add_argument("--venues", type=int, default=48)
+    p.add_argument("--clients", type=int, default=32)
+    p.add_argument("--queries-per-client", type=int, default=64)
+    p.add_argument("--max-batch", type=int, default=32)
+    p.add_argument("--max-wait-ms", type=float, default=2.0)
+    p.add_argument("--k", type=int, default=10)
+    p.add_argument("--backend", default="jax")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--out", default=None, help="write the JSON here")
+    args = p.parse_args(argv)
+
+    if args.smoke:
+        result = run_smoke(args.out)
+    else:
+        result = run_bench(
+            n_authors=args.authors, n_papers=args.papers,
+            n_venues=args.venues, clients=args.clients,
+            queries_per_client=args.queries_per_client,
+            max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
+            k=args.k, backend=args.backend, seed=args.seed,
+        )
+        if args.out:
+            with open(args.out, "w", encoding="utf-8") as f:
+                json.dump(result, f, indent=2)
+    json.dump(result, sys.stdout, indent=2)
+    print()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -72,6 +72,54 @@ class PathSimBackend(abc.ABC):
         row = np.asarray(self.pairwise_row(source_index), dtype=np.float64)
         return pathsim.score_row(row, d[source_index], d, xp=np)
 
+    # -- batched multi-row path (serving layer) ----------------------------
+    #
+    # The serving coalescer pads concurrent single-source queries into
+    # power-of-two shape buckets and dispatches them here. The contract:
+    # every row of a batched result is bit-identical to the unbatched
+    # call for that row. That holds because (a) path counts are exact
+    # integers under each backend's dtype guard, so any summation order
+    # yields the same numbers, and (b) normalization + top-k selection
+    # run through the same f64 host code either way.
+
+    def pairwise_rows(self, rows) -> np.ndarray:
+        """M[rows, :] stacked: float[B, N], integer-valued. Backends
+        override with one batched dispatch; the fallback loops."""
+        return np.stack(
+            [
+                np.asarray(self.pairwise_row(int(r)), dtype=np.float64)
+                for r in np.asarray(rows, dtype=np.int64)
+            ]
+        )
+
+    def scores_rows(self, rows, variant: str = "rowsum") -> np.ndarray:
+        """Score rows for a batch of sources: f64 [B, N]."""
+        rows = np.asarray(rows, dtype=np.int64)
+        d = np.asarray(self._denominators(variant), dtype=np.float64)
+        m = np.asarray(self.pairwise_rows(rows), dtype=np.float64)
+        return pathsim.score_rows(m, d[rows], d, xp=np)
+
+    def topk_rows(self, rows, k: int = 10, variant: str = "rowsum"):
+        """Batched per-source top-k: (values f64 [B, k], indices int64
+        [B, k]), self pairs excluded, ordered (descending score,
+        ascending column) — the oracle tie order. ``k`` is clamped to
+        N−1 (a self pair can never rank)."""
+        rows = np.asarray(rows, dtype=np.int64)
+        scores = self.scores_rows(rows, variant=variant)
+        scores[np.arange(rows.shape[0]), rows] = -np.inf
+        return pathsim.topk_from_score_rows(
+            scores, min(k, max(scores.shape[1] - 1, 1))
+        )
+
+    def topk_row(self, row: int, k: int = 10, variant: str = "rowsum"):
+        """Single-source top-k — the B=1 case of :meth:`topk_rows`
+        (identical code path, so batched vs unbatched can never
+        diverge)."""
+        vals, idxs = self.topk_rows(
+            np.asarray([row], dtype=np.int64), k=k, variant=variant
+        )
+        return vals[0], idxs[0]
+
     def all_pairs_scores(self, variant: str = "rowsum") -> np.ndarray:
         m = np.asarray(self.commuting_matrix(), dtype=np.float64)
         rowsums = (

@@ -67,6 +67,17 @@ def _diag_from_half(c):
 
 
 @jax.jit
+def _pairwise_rows_half(c, rows):
+    """M[rows, :] = C[rows] @ Cᵀ — one batched GEMM for a whole serving
+    bucket. jit specializes on the (static) batch length, so the serving
+    layer's power-of-two padding means XLA compiles exactly one program
+    per bucket; every request batch after warmup reuses a cached
+    executable."""
+    with jax.default_matmul_precision("highest"):
+        return jnp.matmul(jnp.take(c, rows, axis=0), c.T)
+
+
+@jax.jit
 def _rowsums_asym(blocks):
     """Row sums of an arbitrary chain by folding the ones-vector from the
     right — never materializes anything wider than a block."""
@@ -184,6 +195,34 @@ class JaxDenseBackend(PathSimBackend):
                 self._check_exact(self._rowsums)
             return np.asarray(row, dtype=np.float64)
         return self._compute()[0][source_index]
+
+    def pairwise_rows(self, rows) -> np.ndarray:
+        """Batched M[rows, :] — host view of :meth:`pairwise_rows_device`
+        (the serving layer uses the device handle directly to overlap
+        transfer with the next bucket's dispatch)."""
+        out = self.pairwise_rows_device(rows)
+        if out is None:
+            return super().pairwise_rows(rows)
+        return np.asarray(out, dtype=np.float64)
+
+    def pairwise_rows_device(self, rows):
+        """Batched row counts as a DEVICE array (async dispatch: the
+        call returns before the GEMM finishes, which is what lets the
+        serving layer double-buffer — issue bucket N+1 while bucket N's
+        result transfers to host). Returns None when no device fast
+        path exists (asymmetric chain: counts come from the cached M)."""
+        if not self._symmetric:
+            return None
+        c, rowsums = self._half()
+        out = _pairwise_rows_half(
+            c, jnp.asarray(np.asarray(rows, dtype=np.int64), dtype=jnp.int32)
+        )
+        # same exactness contract as pairwise_row: guard even when this
+        # is the first call on the backend
+        if self._rowsums is None:
+            self._rowsums = np.asarray(rowsums, dtype=np.float64)
+            self._check_exact(self._rowsums)
+        return out
 
     # -- on-device scoring fast paths -------------------------------------
 

@@ -176,6 +176,14 @@ class JaxShardedBackend(PathSimBackend):
     def pairwise_row(self, source_index: int) -> np.ndarray:
         return self.commuting_matrix()[source_index]
 
+    def pairwise_rows(self, rows) -> np.ndarray:
+        """Batched M[rows, :]: one fancy-index gather from the (already
+        sharded-computed, host-resident) commuting matrix — the serving
+        bucket costs a memcpy, not B row copies through the base-class
+        loop. The first call pays the distributed M build; a warm
+        serving process holds M for its lifetime."""
+        return self.commuting_matrix()[np.asarray(rows, dtype=np.int64)]
+
     def topk(self, k: int = 10, mask_self: bool = True,
              variant: str = "rowsum"):
         """Distributed per-row top-k via the ppermute ring: no device

@@ -178,6 +178,29 @@ class JaxSparseBackend(PathSimBackend):
             out[j * t.tile_rows : (j + 1) * t.tile_rows] = tile[0]
         return out[: self.n]
 
+    def pairwise_rows(self, rows) -> np.ndarray:
+        """Batched M[rows, :] for the serving coalescer: the B source
+        factor rows are gathered into one dense [B, V] device block and
+        swept across the column tiles — n_tiles dispatches for the whole
+        bucket instead of B·n_tiles. Under the exact-count guard every
+        f32 tile product is an exact integer, so this agrees bit-for-bit
+        with the per-row sweep; in exact-rescore mode (counts past 2²⁴)
+        each row takes the exact f64 host path instead."""
+        rows = np.asarray(rows, dtype=np.int64)
+        if self._exact_rescore:
+            return np.stack(
+                [self.pairwise_row_exact(int(r)) for r in rows]
+            )
+        t = self.tiled
+        src = jnp.asarray(self._densify_rows_f64(rows), dtype=t.dtype)
+        out = np.zeros(
+            (rows.shape[0], t.n_tiles * t.tile_rows), dtype=np.float64
+        )
+        for j in range(t.n_tiles):
+            tile = np.asarray(sp.tile_outer(src, t.tile(j)), dtype=np.float64)
+            out[:, j * t.tile_rows : (j + 1) * t.tile_rows] = tile
+        return out[:, : self.n]
+
     def _run_config(self, k: int, symmetric: bool = True,
                     variant: str = "rowsum") -> dict:
         """Checkpoint identity: graph fingerprint + tiling + k + score

@@ -55,3 +55,18 @@ class NumpyBackend(PathSimBackend):
         for b in self._blocks[1:]:
             v = v @ b
         return v
+
+    def pairwise_rows(self, rows) -> np.ndarray:
+        """Batched M[rows, :] as ONE GEMM against the half factor (or a
+        row-sliced chain fold) — the serving coalescer's dispatch unit.
+        f64 path counts are exact integers below 2⁵³, so the GEMM's sum
+        order cannot diverge from the per-row GEMV."""
+        rows = np.asarray(rows, dtype=np.int64)
+        if self._m is not None:
+            return self._m[rows]
+        if self._c is not None:
+            return self._c[rows] @ self._c.T
+        v = self._blocks[0][rows]
+        for b in self._blocks[1:]:
+            v = v @ b
+        return v

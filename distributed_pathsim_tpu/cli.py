@@ -140,6 +140,21 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv: list[str] | None = None) -> int:
     from .resilience import PREEMPTED_EXIT_CODE, Preempted, preemption_handler
 
+    # Subcommand routing: ``dpathsim serve ...`` is the online serving
+    # entry point (serving/cli.py); everything else stays the classic
+    # flag-driven batch CLI, so existing invocations are untouched.
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "serve":
+        from .serving.cli import serve_main
+
+        try:
+            return serve_main(argv[1:])
+        except (KeyError, ValueError, FileNotFoundError) as exc:
+            msg = exc.args[0] if exc.args else exc
+            print(f"error: {msg}", file=sys.stderr)
+            return 1
+
     # SIGTERM/SIGINT become a graceful preemption: the streaming tile
     # loop flushes its in-flight work through the CheckpointManager and
     # raises Preempted; we exit 75 (EX_TEMPFAIL — "re-run me") with a

@@ -203,7 +203,10 @@ class PathSimDriver:
 
     def top_k(self, source: str, k: int = 10, by_label: bool = True):
         """Ranked similar nodes — similarity *search*, the purpose PathSim
-        serves in Sun et al."""
+        serves in Sun et al. Routed through the backend's ``topk_row``
+        primitive (the same code the serving layer's coalesced batches
+        dispatch to), so a CLI query and a served query can never
+        disagree on scores or tie order."""
         res_index = (
             self.hin.find_index_by_label(self.node_type, source)
             if by_label
@@ -211,11 +214,9 @@ class PathSimDriver:
         )
         if res_index is None:
             raise KeyError(f"unknown {self.node_type} {source!r}")
-        scores = self.backend.scores_from_source(res_index, variant=self.variant)
-        scores = np.asarray(scores, dtype=np.float64).copy()
-        scores[res_index] = -np.inf  # exclude self, like the reference's loop
-        order = np.argsort(-scores, kind="stable")[:k]
+        vals, idxs = self.backend.topk_row(res_index, k=k, variant=self.variant)
         return [
-            (self.index.ids[i], self.index.labels[i], float(scores[i]))
-            for i in order
+            (self.index.ids[int(i)], self.index.labels[int(i)], float(v))
+            for v, i in zip(vals, idxs)
+            if np.isfinite(v)
         ]

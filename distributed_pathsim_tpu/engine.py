@@ -101,6 +101,15 @@ def load_dataset(
 def build(
     config: RunConfig, timer=None
 ) -> tuple[EncodedHIN, MetaPath, PathSimBackend, PathSimDriver]:
+    """Full batch bootstrap: :func:`build_backend` plus the driver."""
+    hin, metapath, backend = build_backend(config, timer=timer)
+    driver = PathSimDriver(backend, variant=config.variant)
+    return hin, metapath, backend, driver
+
+
+def build_backend(
+    config: RunConfig, timer=None
+) -> tuple[EncodedHIN, MetaPath, PathSimBackend]:
     """``timer``: optional StageTimer; bootstrap phases (GEXF load +
     encode, metapath compile, backend init — which for the sparse
     backend includes the host half-chain fold) are recorded on it.
@@ -108,7 +117,12 @@ def build(
     Every bootstrap phase is a resilience seam: transient failures are
     retried per ``config.max_retries``; a backend whose init keeps
     failing steps down the degradation chain (jax-sharded → jax →
-    numpy) unless ``config.degrade`` is False."""
+    numpy) unless ``config.degrade`` is False.
+
+    This is also the serving layer's (re)load path: ``dpathsim serve``
+    builds a backend here, wraps it in a PathSimService, and a graph
+    reload builds another one and swaps it in — the driver object is
+    batch-CLI-only, hence the split."""
     if timer is None:
         from .utils.profiling import StageTimer
 
@@ -149,8 +163,7 @@ def build(
             degrade=config.degrade,
             **options,
         )
-    driver = PathSimDriver(backend, variant=config.variant)
-    return hin, metapath, backend, driver
+    return hin, metapath, backend
 
 
 def _resolve_dtype(backend: str, dtype: str):

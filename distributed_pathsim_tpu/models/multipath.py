@@ -220,23 +220,42 @@ class MultiMetapathScorer:
         return d_all
 
     def _row_scores_streaming(self, row: int) -> np.ndarray:
-        """Per-path single-source score rows [R, N] in O(Σ_r nnz_r):
-        sim_r(row, j) = 2·(C_r[row]·C_r[j]) / (d_r[row] + d_r[j]) with
-        the numerator as one sparse gather-multiply-scatter per path.
-        Exact f64 (integer counts sum exactly below 2⁵³) — this is the
-        path the CLI's single-source ensemble takes at scales where the
-        dense stack cannot exist."""
+        """Per-path single-source score rows [R, N] — the B=1 case of
+        :meth:`_rows_scores_streaming` (one implementation, so the
+        serving layer's batched path can never diverge from it)."""
+        return self._rows_scores_streaming(np.asarray([row]))[:, 0, :]
+
+    def _rows_scores_streaming(self, rows: np.ndarray) -> np.ndarray:
+        """Batched streaming score rows [R, B, N] in O(B·Σ_r nnz_r):
+        sim_r(row_b, j) = 2·(C_r[row_b]·C_r[j]) / (d_r[row_b] + d_r[j])
+        with the numerators as one sparse gather-multiply-scatter per
+        path for the WHOLE batch. Exact f64 (integer counts sum exactly
+        below 2⁵³, so accumulation order is irrelevant) — the same
+        exactness contract the single-row path has always had, now
+        amortizing the per-path COO walk over every row the serving
+        coalescer packed into the bucket. The dense stack never exists."""
+        rows = np.asarray(rows, dtype=np.int64)
         d_all = self.global_walks()  # cached [R, N]; exact either way
-        out = np.zeros((len(self._coo), self.n))
+        out = np.zeros((len(self._coo), rows.shape[0], self.n))
         for r, c in enumerate(self._coo):
             w = c.weights
-            src = np.zeros(c.shape[1])
-            mask = c.rows == row
-            src[c.cols[mask]] = w[mask]  # coalesced: one entry per col
-            cc = np.bincount(
-                c.rows, weights=w * src[c.cols], minlength=self.n
-            )
-            denom = d_all[r, row] + d_all[r]
+            src = np.zeros((rows.shape[0], c.shape[1]))
+            for b, row in enumerate(rows):
+                mask = c.rows == row
+                src[b, c.cols[mask]] = w[mask]  # coalesced: 1/col
+            # cc[b, i] = Σ_e w_e · src[b, col_e] over entries of row i.
+            # bincount per batch row, NOT one np.add.at scatter: add.at
+            # is an unbuffered per-element ufunc loop, ~10-100× slower
+            # than bincount's C path — and B=1 here IS the pre-existing
+            # single-source CLI ensemble at dense-infeasible nnz.
+            gathered = src[:, c.cols]  # [B, nnz]
+            cc = np.stack([
+                np.bincount(
+                    c.rows, weights=w * gathered[b], minlength=self.n
+                )
+                for b in range(rows.shape[0])
+            ])
+            denom = d_all[r, rows][:, None] + d_all[r][None, :]
             out[r] = np.where(denom > 0, 2.0 * cc / np.where(
                 denom > 0, denom, 1.0), 0.0)
         return out
@@ -317,18 +336,36 @@ class MultiMetapathScorer:
             np.asarray(idxs, dtype=np.int64)[: self.n],
         )
 
-    def topk_row(self, row: int, k: int = 10, weights: Sequence[float] | None = None):
-        """Top-k for ONE source row — ranks only that row, ALWAYS via
-        the streaming exact-f64 O(nnz) path. The dense f32 all-pairs
-        cache is deliberately not reused here: results must be
-        call-order independent — the same query on the same scorer
-        returned slightly different scores and tie orders depending on
-        whether an all-pairs method had run first (ADVICE r5)."""
+    def topk_rows(
+        self,
+        rows,
+        k: int = 10,
+        weights: Sequence[float] | None = None,
+    ):
+        """Batched :meth:`topk_row` — the serving coalescer's dispatch
+        unit for multi-metapath services: (values f64 [B, k], indices
+        int64 [B, k]). ALWAYS the streaming exact-f64 O(B·nnz) path;
+        the dense f32 all-pairs cache is deliberately not reused, so
+        results are call-order independent — the same query on the same
+        scorer must not change scores or tie orders depending on
+        whether an all-pairs method ran first (ADVICE r5). Tie order is
+        (descending score, ascending column), the oracle convention the
+        single-backend serving path uses."""
+        from ..ops import pathsim
+
         w = self._resolve_weights(weights).astype(np.float64)
-        s = np.einsum("rn,r->n", self._row_scores_streaming(row), w)
-        s[row] = -np.inf
-        k = min(k, s.shape[0] - 1)
-        part = np.argpartition(-s, k - 1)[:k]
-        order = np.argsort(-s[part], kind="stable")
-        idxs = part[order]
-        return s[idxs], idxs
+        rows = np.asarray(rows, dtype=np.int64)
+        s = np.einsum("rbn,r->bn", self._rows_scores_streaming(rows), w)
+        s[np.arange(rows.shape[0]), rows] = -np.inf
+        return pathsim.topk_from_score_rows(
+            s, min(k, max(s.shape[1] - 1, 1))
+        )
+
+    def topk_row(self, row: int, k: int = 10, weights: Sequence[float] | None = None):
+        """Top-k for ONE source row — the B=1 case of :meth:`topk_rows`
+        (identical code path, so the coalesced serving dispatch can
+        never diverge from the direct CLI query)."""
+        vals, idxs = self.topk_rows(
+            np.asarray([row], dtype=np.int64), k=k, weights=weights
+        )
+        return vals[0], idxs[0]

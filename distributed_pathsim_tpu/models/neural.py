@@ -426,6 +426,20 @@ class NeuralPathSim:
                 cand[:hard_rows, n_pos:n_pos + n_hard] = self._hard_cand[
                     pool_idx[:, None], pick
                 ]
+        if self.mesh is not None and hard_rows:
+            # The dp mesh shards the source axis CONTIGUOUSLY, and hard
+            # pool rows were just assembled at the front of the batch —
+            # without a shuffle every hard slate lands on the low-index
+            # devices, skewing per-device gradients (and per-device
+            # work) for the whole run (ADVICE r5). One permutation
+            # restores exchangeability; slates stay intact because src,
+            # cand, and (downstream) tgt are permuted together. Gated
+            # on an installed pool: a pool-less batch is already
+            # exchangeable, and consuming rng state for it would break
+            # sharded == single-device batch parity.
+            perm = rng.permutation(b)
+            src = src[perm]
+            cand = cand[perm]
         tgt = self.pair_scores(
             np.repeat(src, s), cand.reshape(-1)
         ).reshape(b, s)

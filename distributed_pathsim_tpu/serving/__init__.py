@@ -1,0 +1,25 @@
+"""Online serving layer: coalescing, shape buckets, multi-tier cache.
+
+See DESIGN.md §18. Public surface:
+
+- :class:`PathSimService` / :class:`ServeConfig` / :func:`build_service`
+  — the warm query frontend (service.py);
+- :class:`LoadShedError` / :class:`ServiceClosed` — admission and
+  lifecycle failures callers handle (coalescer.py);
+- :func:`graph_fingerprint` — the cache-identity hash (cache.py);
+- :func:`serve_loop` / :func:`handle_request` — the JSONL protocol
+  (protocol.py); the ``dpathsim serve`` subcommand lives in cli.py.
+"""
+
+from .cache import graph_fingerprint
+from .coalescer import LoadShedError, ServiceClosed
+from .service import PathSimService, ServeConfig, build_service
+
+__all__ = [
+    "PathSimService",
+    "ServeConfig",
+    "build_service",
+    "LoadShedError",
+    "ServiceClosed",
+    "graph_fingerprint",
+]

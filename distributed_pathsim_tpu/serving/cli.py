@@ -1,0 +1,132 @@
+"""``dpathsim serve`` — the online serving entry point.
+
+Bootstraps the engine once (same flags as the batch CLI: dataset,
+backend, metapath, variant, platform, loader), wraps the warm backend
+in a :class:`PathSimService`, and speaks the JSONL protocol on
+stdin/stdout until EOF or a ``shutdown`` op::
+
+    echo '{"id": 1, "op": "topk", "source": "Didier Dubois", "k": 5}' \
+        | dpathsim serve --dataset dblp/dblp_small.gexf --backend jax
+
+Structured events (bucket warm times, batch accounting, sheds, reload)
+ride the same --metrics JSONL channel the batch CLI uses.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from ..backends.base import available_backends
+from ..config import RunConfig
+from ..ops.pathsim import VARIANTS
+from .service import ServeConfig, build_service
+
+
+def build_serve_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="dpathsim serve",
+        description="online PathSim serving: JSONL queries on stdin, "
+        "JSONL answers on stdout",
+    )
+    p.add_argument("--dataset", default=RunConfig.dataset)
+    p.add_argument("--backend", default="jax", choices=available_backends())
+    p.add_argument("--metapath", default="APVPA")
+    p.add_argument("--variant", default="rowsum", choices=list(VARIANTS))
+    p.add_argument(
+        "--loader", default="auto", choices=("auto", "python", "native")
+    )
+    p.add_argument("--platform", default="auto", choices=("auto", "cpu", "tpu"))
+    p.add_argument("--dtype", default="float32")
+    p.add_argument("--n-devices", type=int, default=None)
+    p.add_argument("--tile-rows", type=int, default=None)
+    p.add_argument("--approx", action="store_true")
+    p.add_argument("--metrics", default=None, help="JSONL metrics/events file")
+    p.add_argument("--k", type=int, default=10, help="default top-k")
+    p.add_argument(
+        "--max-batch", type=int, default=32,
+        help="coalescing cap; buckets are powers of two up to this",
+    )
+    p.add_argument(
+        "--max-wait-ms", type=float, default=2.0,
+        help="how long a formed batch waits for stragglers",
+    )
+    p.add_argument(
+        "--queue-depth", type=int, default=256,
+        help="admission bound; requests beyond it are shed",
+    )
+    p.add_argument(
+        "--cache-entries", type=int, default=4096,
+        help="result LRU capacity (0 disables tier 1)",
+    )
+    p.add_argument(
+        "--tile-cache-mb", type=float, default=64.0,
+        help="hot-tile score cache budget (0 disables tier 2)",
+    )
+    p.add_argument(
+        "--no-warm", action="store_true",
+        help="skip pre-compiling the shape buckets at startup",
+    )
+    p.add_argument(
+        "--batch-events", action="store_true",
+        help="emit a JSONL event per dispatched batch",
+    )
+    return p
+
+
+def serve_main(argv: list[str] | None = None) -> int:
+    args = build_serve_parser().parse_args(argv)
+    if "," in args.metapath:
+        raise ValueError(
+            "serve runs one metapath per service; multi-metapath "
+            "ensembles are not served yet"
+        )
+    from ..cli import _apply_platform, _require_tpu
+
+    _apply_platform(args.platform)
+
+    from ..utils.logging import RunLogger, set_event_sink
+    from .protocol import serve_loop
+
+    config = RunConfig(
+        dataset=args.dataset,
+        backend=args.backend,
+        metapath=args.metapath,
+        variant=args.variant,
+        loader=args.loader,
+        dtype=args.dtype,
+        n_devices=args.n_devices,
+        tile_rows=args.tile_rows,
+        approx=args.approx,
+        echo=False,
+    )
+    serve_config = ServeConfig(
+        max_batch=args.max_batch,
+        max_wait_ms=args.max_wait_ms,
+        queue_depth=args.queue_depth,
+        cache_entries=args.cache_entries,
+        tile_cache_bytes=int(args.tile_cache_mb * (1 << 20)),
+        k_default=args.k,
+        warm=not args.no_warm,
+        batch_events=args.batch_events,
+    )
+    logger = RunLogger(output_path=None, echo=False,
+                       metrics_path=args.metrics)
+    set_event_sink(logger)
+    service = None
+    try:
+        service = build_service(config, serve_config)
+        if args.platform == "tpu":
+            _require_tpu()
+        print(
+            f"serving {service.metapath.name} over {service.n} "
+            f"{service.node_type}s (backend={service.backend.name}); "
+            "JSONL on stdin",
+            file=sys.stderr,
+        )
+        return serve_loop(service, sys.stdin, sys.stdout)
+    finally:
+        if service is not None:
+            service.close()
+        set_event_sink(None)
+        logger.close()

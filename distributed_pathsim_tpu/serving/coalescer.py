@@ -1,0 +1,264 @@
+"""Request coalescing: concurrent queries → padded batch dispatches.
+
+The serving hot loop is dispatch-overhead-bound, not FLOP-bound: a
+single-row query pays a jit call (and on a tunneled TPU a ~70 ms RPC)
+for a GEMV that is microseconds of arithmetic. The coalescer collapses
+that overhead: requests land in a bounded queue; a dispatcher thread
+forms a batch (up to ``max_batch``, waiting at most ``max_wait_ms`` for
+stragglers once the first request arrives), pads it to a power-of-two
+shape bucket (buckets.py), and issues ONE batched dispatch.
+
+**Double buffering**: the dispatcher hands the in-flight result (a
+device array under JAX's async dispatch) to a completion thread through
+a depth-2 queue and immediately forms the next batch — so batch N+1's
+GEMM is issued while batch N's results transfer to host and fan back
+out to their futures. With a synchronous backend (numpy) the same
+structure degenerates gracefully: issue computes, complete routes.
+
+**Admission control**: the queue is bounded (``queue_depth``). When
+it's full the submit fails immediately with :class:`LoadShedError` and
+a structured ``serve_shed`` event — shedding at the door keeps the
+latency of admitted requests bounded instead of letting the queue grow
+without limit under overload (the JSONL event stream is how an operator
+sees it happening).
+
+Every result is routed to exactly the future whose request produced it
+(request identity, not value: two concurrent queries for the same row
+each get their own completion) — verified under concurrent submitters
+by test.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+from ..utils.logging import runtime_event
+from . import buckets as bk
+
+
+class LoadShedError(RuntimeError):
+    """Admission refused: the serving queue is at its bound."""
+
+
+class ServiceClosed(RuntimeError):
+    """The service shut down before (or while) handling the request."""
+
+
+@dataclasses.dataclass
+class Request:
+    """One admitted query. ``k`` is the requested top-k; the batch is
+    dispatched at the batch's max k and each request gets its prefix."""
+
+    row: int
+    k: int
+    future: Future
+    t_enqueue: float
+
+
+@dataclasses.dataclass
+class BatchStats:
+    """Per-dispatch accounting, folded into the service's stats."""
+
+    n_requests: int
+    bucket: int
+    wait_ms: float
+
+
+class Coalescer:
+    """Batch former + double-buffered dispatch pipeline.
+
+    ``issue(rows_padded, k)`` runs on the dispatcher thread and returns
+    an opaque in-flight handle (device array, host array — anything);
+    ``complete(handle, rows, requests, k)`` runs on the completion
+    thread and must resolve every request's future. Exceptions from
+    either land on every future of the batch.
+    """
+
+    def __init__(
+        self,
+        issue: Callable[[np.ndarray, int], Any],
+        complete: Callable[[Any, np.ndarray, Sequence[Request], int], None],
+        max_batch: int = 32,
+        max_wait_ms: float = 2.0,
+        queue_depth: int = 256,
+        bucket_ladder: tuple[int, ...] | None = None,
+        on_batch: Callable[[BatchStats], None] | None = None,
+    ):
+        self._issue = issue
+        self._complete = complete
+        self.max_batch = int(max_batch)
+        self.max_wait_s = float(max_wait_ms) / 1e3
+        self.queue_depth = int(queue_depth)
+        self.buckets = bucket_ladder or bk.bucket_ladder(self.max_batch)
+        if self.buckets[-1] < self.max_batch:
+            raise ValueError(
+                f"bucket ladder {self.buckets} cannot cover "
+                f"max_batch={self.max_batch}"
+            )
+        self._on_batch = on_batch
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._queue: collections.deque[Request] = collections.deque()
+        self._closing = False
+        self.shed_count = 0
+        self.batch_count = 0
+        self.dispatched_requests = 0
+        # Depth 2 = the double buffer: one batch completing + one in
+        # flight; a third batch blocks at put() until a slot frees,
+        # which back-pressures the dispatcher instead of racing ahead.
+        self._inflight: queue.Queue = queue.Queue(maxsize=2)
+        # Batches issued but not yet fully completed — the drain()
+        # condition (a queue can look empty while the completion thread
+        # is mid-batch, and reload must not swap state under it).
+        self._inflight_n = 0
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, name="pathsim-serve-dispatch",
+            daemon=True,
+        )
+        self._completer = threading.Thread(
+            target=self._complete_loop, name="pathsim-serve-complete",
+            daemon=True,
+        )
+        self._dispatcher.start()
+        self._completer.start()
+
+    # -- admission ---------------------------------------------------------
+
+    def submit(self, row: int, k: int) -> Future:
+        """Admit one query; returns its Future. Raises
+        :class:`LoadShedError` immediately when the queue is at bound —
+        overload must fail fast, not queue unboundedly."""
+        fut: Future = Future()
+        with self._lock:
+            if self._closing:
+                raise ServiceClosed("serving layer is shut down")
+            if len(self._queue) >= self.queue_depth:
+                self.shed_count += 1
+                shed = self.shed_count
+                # stderr echo only every 100th shed: under sustained
+                # overload the event stream must not become the load
+                runtime_event(
+                    "serve_shed",
+                    depth=self.queue_depth,
+                    total_shed=shed,
+                    echo=(shed == 1 or shed % 100 == 0),
+                )
+                raise LoadShedError(
+                    f"serving queue at bound ({self.queue_depth}); "
+                    "request shed"
+                )
+            self._queue.append(
+                Request(row=int(row), k=int(k), future=fut,
+                        t_enqueue=time.monotonic())
+            )
+            self._not_empty.notify()
+        return fut
+
+    # -- pipeline ----------------------------------------------------------
+
+    def _take_batch(self) -> list[Request] | None:
+        """Block for the first request, then coalesce stragglers up to
+        ``max_batch`` or ``max_wait``. Returns None on shutdown."""
+        with self._lock:
+            while not self._queue:
+                if self._closing:
+                    return None
+                self._not_empty.wait()
+            # Counted as in flight from the moment the FIRST request
+            # leaves the queue — before the straggler wait below, which
+            # releases the lock: drain() must not report idle while a
+            # batch is half-formed, or reload() could swap the backend
+            # under it and dispatch old-graph rows against the new one.
+            batch = [self._queue.popleft()]
+            self._inflight_n += 1
+            deadline = batch[0].t_enqueue + self.max_wait_s
+            while len(batch) < self.max_batch:
+                if self._queue:
+                    batch.append(self._queue.popleft())
+                    continue
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or self._closing:
+                    break
+                self._not_empty.wait(remaining)
+        return batch
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            batch = self._take_batch()
+            if batch is None:
+                self._inflight.put(None)  # completion-thread shutdown
+                return
+            rows = np.array([r.row for r in batch], dtype=np.int64)
+            k = max(r.k for r in batch)
+            bucket = bk.bucket_for(rows.shape[0], self.buckets)
+            padded = bk.pad_rows(rows, bucket)
+            wait_ms = (
+                time.monotonic() - batch[0].t_enqueue
+            ) * 1e3
+            try:
+                handle = self._issue(padded, k)
+            except BaseException as exc:  # route, don't kill the thread
+                for r in batch:
+                    r.future.set_exception(exc)
+                with self._lock:
+                    self._inflight_n -= 1
+                continue
+            self.batch_count += 1
+            self.dispatched_requests += len(batch)
+            if self._on_batch is not None:
+                self._on_batch(
+                    BatchStats(
+                        n_requests=len(batch), bucket=bucket,
+                        wait_ms=wait_ms,
+                    )
+                )
+            self._inflight.put((handle, rows, batch, k))
+
+    def _complete_loop(self) -> None:
+        while True:
+            item = self._inflight.get()
+            if item is None:
+                return
+            handle, rows, batch, k = item
+            try:
+                self._complete(handle, rows, batch, k)
+            except BaseException as exc:
+                for r in batch:
+                    if not r.future.done():
+                        r.future.set_exception(exc)
+            finally:
+                with self._lock:
+                    self._inflight_n -= 1
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def drain(self, timeout: float = 30.0) -> None:
+        """Wait until the queue and the in-flight pipeline are empty
+        (reload uses this: no batch may straddle a backend swap)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                idle = not self._queue and self._inflight_n == 0
+            if idle:
+                return
+            time.sleep(0.002)
+        raise TimeoutError("serving pipeline did not drain")
+
+    def close(self) -> None:
+        with self._lock:
+            self._closing = True
+            pending = list(self._queue)
+            self._queue.clear()
+            self._not_empty.notify_all()
+        for r in pending:
+            r.future.set_exception(ServiceClosed("serving layer shut down"))
+        self._dispatcher.join(timeout=10)
+        self._completer.join(timeout=10)

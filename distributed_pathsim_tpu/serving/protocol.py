@@ -1,0 +1,112 @@
+"""The ``serve`` wire protocol: JSONL over stdin/stdout.
+
+One JSON object per line in, one per line out — the same transport
+every piece of this repo's tooling already speaks (metrics JSONL,
+checkpoint manifests), and the tier-1 test suite can drive it through a
+pipe with no network dependency. A network front (HTTP, gRPC) would be
+a thin adapter over :func:`handle_request`; the protocol layer is
+deliberately transport-free.
+
+Requests::
+
+    {"id": 1, "op": "topk", "source": "Didier Dubois", "k": 10}
+    {"id": 2, "op": "topk", "row": 17}
+    {"id": 3, "op": "scores", "source_id": "author_395340"}
+    {"id": 4, "op": "stats"}
+    {"id": 5, "op": "invalidate"}
+    {"id": 6, "op": "ping"}
+    {"id": 7, "op": "shutdown"}
+
+Responses mirror the id and carry ``ok``; successes add ``result`` and
+``latency_ms``, failures add ``error``. Unknown ops / bad JSON are
+per-request errors, never process exits: one malformed client line must
+not take the service down for everyone else.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import IO
+
+from .service import PathSimService
+
+_QUERY_KEYS = ("source", "source_id", "row")
+
+
+def handle_request(service: PathSimService, req: dict) -> dict:
+    """One request dict → one response dict (transport-free core)."""
+    rid = req.get("id")
+    op = req.get("op", "topk")
+    t0 = time.perf_counter()
+    try:
+        if op == "ping":
+            result = {"pong": True}
+        elif op == "stats":
+            result = service.stats()
+        elif op == "invalidate":
+            service.invalidate()
+            result = {"invalidated": True}
+        elif op == "topk":
+            kwargs = {key: req.get(key) for key in _QUERY_KEYS}
+            if all(v is None for v in kwargs.values()):
+                raise KeyError(
+                    "topk needs one of source / source_id / row"
+                )
+            hits = service.topk(k=req.get("k"), **kwargs)
+            result = {
+                "topk": [
+                    {"id": i, "label": lab, "score": s}
+                    for i, lab, s in hits
+                ]
+            }
+        elif op == "scores":
+            row = service.resolve(
+                source=req.get("source"),
+                source_id=req.get("source_id"),
+                row=req.get("row"),
+            )
+            result = {"row": row,
+                      "scores": service.scores_index(row).tolist()}
+        else:
+            raise KeyError(f"unknown op {op!r}")
+    except Exception as exc:  # per-request failure, not process failure
+        msg = exc.args[0] if exc.args else repr(exc)
+        return {"id": rid, "ok": False, "error": str(msg)}
+    return {
+        "id": rid,
+        "ok": True,
+        "result": result,
+        "latency_ms": round((time.perf_counter() - t0) * 1e3, 3),
+    }
+
+
+def serve_loop(
+    service: PathSimService, in_stream: IO[str], out_stream: IO[str]
+) -> int:
+    """Read JSONL requests until EOF or a ``shutdown`` op; write one
+    JSONL response per request, flushed per line (a pipe peer must see
+    the answer without waiting for buffering)."""
+    for line in in_stream:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            req = json.loads(line)
+            if not isinstance(req, dict):
+                raise ValueError("request must be a JSON object")
+        except ValueError as exc:
+            resp = {"id": None, "ok": False, "error": f"bad request: {exc}"}
+            out_stream.write(json.dumps(resp) + "\n")
+            out_stream.flush()
+            continue
+        if req.get("op") == "shutdown":
+            out_stream.write(
+                json.dumps({"id": req.get("id"), "ok": True,
+                            "result": {"shutdown": True}}) + "\n"
+            )
+            out_stream.flush()
+            return 0
+        out_stream.write(json.dumps(handle_request(service, req)) + "\n")
+        out_stream.flush()
+    return 0

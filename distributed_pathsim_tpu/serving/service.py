@@ -1,0 +1,352 @@
+"""PathSimService: a warm, coalescing, caching online query frontend.
+
+Every entry point before this PR was a one-shot batch job: each top-k
+query re-paid graph load, backend init, jit compile, and an unbatched
+dispatch. The service inverts that. Construction does the expensive
+work ONCE — the backend's half factor is assembled and left resident on
+device, the denominator vector is prefetched to host f64, and every
+serving shape bucket is pre-compiled (``utils.xla_flags.
+warm_compile_cache``) — and then queries flow through three tiers:
+
+1. result LRU (cache.py) — repeated (row, k) queries are a dict lookup;
+2. hot-tile score cache — a known score row re-selects top-k on host
+   for any k, no dispatch;
+3. coalesced batched dispatch (coalescer.py) — misses from concurrent
+   clients are padded into power-of-two buckets and served by ONE
+   batched backend call, double-buffered so bucket N+1's GEMM overlaps
+   bucket N's host transfer.
+
+Served results are bit-identical to the offline driver's ``top_k``:
+both route through the backend's ``topk_row``/``topk_rows`` arithmetic
+(exact integer counts, f64 normalization, (descending score, ascending
+column) tie order) — verified by test, padding and batching included.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from concurrent.futures import Future
+from typing import Sequence
+
+import numpy as np
+
+from ..backends.base import PathSimBackend
+from ..ops import pathsim
+from ..utils.logging import runtime_event
+from . import buckets as bk
+from .cache import HotTileCache, ResultCache, graph_fingerprint
+from .coalescer import BatchStats, Coalescer, Request
+
+
+@dataclasses.dataclass
+class ServeConfig:
+    """Serving knobs (all CLI-exposed via the ``serve`` subcommand)."""
+
+    max_batch: int = 32
+    max_wait_ms: float = 2.0
+    queue_depth: int = 256
+    cache_entries: int = 4096        # tier-1 result LRU capacity
+    tile_cache_bytes: int = 64 << 20  # tier-2 hot-tile budget
+    tile_rows: int = 64               # tier-2 eviction granularity
+    k_default: int = 10
+    warm: bool = True                 # pre-compile buckets at startup
+    request_timeout_s: float = 60.0
+    batch_events: bool = False        # per-batch JSONL events
+
+
+class PathSimService:
+    """Holds one warm backend and serves single-source top-k / score
+    queries against it, coalescing concurrent requests."""
+
+    def __init__(
+        self,
+        backend: PathSimBackend,
+        variant: str = "rowsum",
+        config: ServeConfig | None = None,
+    ):
+        self.config = config or ServeConfig()
+        self.variant = variant
+        self._swap_lock = threading.Lock()  # serializes reload vs admit
+        self.result_cache = ResultCache(self.config.cache_entries)
+        self.tile_cache = HotTileCache(
+            self.config.tile_cache_bytes, tile_rows=self.config.tile_rows
+        )
+        self._bucket_hist: dict[int, int] = {}
+        self._wait_ms_sum = 0.0
+        self._install_backend(backend, warm=self.config.warm)
+        self.coalescer = Coalescer(
+            issue=self._issue,
+            complete=self._complete,
+            max_batch=self.config.max_batch,
+            max_wait_ms=self.config.max_wait_ms,
+            queue_depth=self.config.queue_depth,
+            on_batch=self._record_batch,
+        )
+
+    # -- warm state --------------------------------------------------------
+
+    def _install_backend(self, backend: PathSimBackend, warm: bool) -> None:
+        """Make a backend serving-warm: denominators prefetched (for
+        jax backends this also assembles C and leaves it device-
+        resident), fingerprint computed, buckets pre-compiled."""
+        self.backend = backend
+        self.hin = backend.hin
+        self.metapath = backend.metapath
+        self.node_type = backend.metapath.source_type
+        self.index = self.hin.indices[self.node_type]
+        self.n = self.index.size
+        self._fp = graph_fingerprint(self.hin)
+        # epoch key: every cache entry carries it, so entries from a
+        # previous graph can never be served after a reload even if
+        # explicit invalidation were lost
+        self._epoch = (self._fp, self.metapath.name, self.variant)
+        self._d = np.asarray(
+            backend._denominators(self.variant), dtype=np.float64
+        )
+        if warm:
+            from ..utils.xla_flags import warm_compile_cache
+
+            warm_compile_cache(
+                backend,
+                bk.bucket_ladder(self.config.max_batch),
+                k=self.config.k_default,
+                variant=self.variant,
+            )
+
+    # -- dispatch plumbing (runs on coalescer threads) ---------------------
+
+    def _issue(self, rows_padded: np.ndarray, k: int):
+        """Dispatcher-thread half of a batch: returns the in-flight
+        counts handle. jax backends return an un-fetched device array
+        (async dispatch → the double buffer overlaps transfer with the
+        next bucket's GEMM); others return host counts directly."""
+        issue_device = getattr(self.backend, "pairwise_rows_device", None)
+        if issue_device is not None:
+            handle = issue_device(rows_padded)
+            if handle is not None:
+                return handle
+        return self.backend.pairwise_rows(rows_padded)
+
+    def _complete(
+        self,
+        handle,
+        rows: np.ndarray,
+        batch: Sequence[Request],
+        k: int,
+    ) -> None:
+        """Completion-thread half: fetch counts, normalize in f64, top-k
+        per request (each gets the k-prefix it asked for), fill both
+        cache tiers, resolve futures."""
+        counts = np.asarray(handle, dtype=np.float64)[: rows.shape[0]]
+        scores = pathsim.score_rows(counts, self._d[rows], self._d, xp=np)
+        epoch = self._epoch
+        masked = scores.copy()
+        masked[np.arange(rows.shape[0]), rows] = -np.inf
+        k_eff = min(k, max(self.n - 1, 1))
+        vals, idxs = pathsim.topk_from_score_rows(masked, k_eff)
+        for b, req in enumerate(batch):
+            # copy, not a view: a cached view would pin the whole [B, N]
+            # batch array long past the byte budget's accounting
+            self.tile_cache.put_row(epoch, int(rows[b]), scores[b].copy())
+            kr = min(req.k, k_eff)
+            rv, ri = vals[b, :kr], idxs[b, :kr]
+            self.result_cache.put(
+                (*epoch, int(rows[b]), req.k), rv, ri
+            )
+            if not req.future.done():
+                req.future.set_result((rv, ri))
+
+    def _record_batch(self, stats: BatchStats) -> None:
+        self._bucket_hist[stats.bucket] = (
+            self._bucket_hist.get(stats.bucket, 0) + 1
+        )
+        self._wait_ms_sum += stats.wait_ms
+        if self.config.batch_events:
+            runtime_event(
+                "serve_batch",
+                echo=False,
+                n=stats.n_requests,
+                bucket=stats.bucket,
+                wait_ms=round(stats.wait_ms, 3),
+            )
+
+    # -- query API ---------------------------------------------------------
+
+    def resolve(self, source: str | None = None,
+                source_id: str | None = None,
+                row: int | None = None) -> int:
+        """Label / node-id / raw row → dense row index."""
+        if row is not None:
+            if not 0 <= int(row) < self.n:
+                raise KeyError(f"row {row} out of range [0, {self.n})")
+            return int(row)
+        return self.hin.resolve_source(
+            self.node_type, label=source, node_id=source_id
+        )
+
+    def submit_topk(self, row: int, k: int | None = None) -> Future:
+        """Admit a top-k query; returns a Future of (values, indices).
+        Cache hits resolve immediately; misses ride the coalescer.
+        Raises :class:`coalescer.LoadShedError` at the queue bound."""
+        k = int(k or self.config.k_default)
+        with self._swap_lock:
+            return self._submit_topk_locked(int(row), k)
+
+    def _submit_topk_locked(self, row: int, k: int) -> Future:
+        # Under _swap_lock: a reload drains the pipeline then swaps the
+        # backend — admissions must not interleave with that swap (the
+        # drain would never finish, and a request could resolve rows
+        # against one graph and dispatch against another).
+        key = (*self._epoch, int(row), k)
+        hit = self.result_cache.get(key)
+        if hit is not None:
+            fut: Future = Future()
+            fut.set_result(hit)
+            return fut
+        srow = self.tile_cache.get_row(self._epoch, int(row))
+        if srow is not None:
+            masked = srow.copy()
+            masked[int(row)] = -np.inf
+            k_eff = min(k, max(self.n - 1, 1))
+            vals, idxs = pathsim.topk_from_score_rows(
+                masked[None, :], k_eff
+            )
+            self.result_cache.put(key, vals[0], idxs[0])
+            fut = Future()
+            fut.set_result((vals[0], idxs[0]))
+            return fut
+        return self.coalescer.submit(int(row), k)
+
+    def topk_index(self, row: int, k: int | None = None):
+        """Synchronous top-k by dense row index → (values, indices)."""
+        return self.submit_topk(row, k).result(
+            timeout=self.config.request_timeout_s
+        )
+
+    def _ident(self, i: int) -> tuple[str, str]:
+        """(id, label) for a dense index — huge synthetic graphs carry
+        implicit range ids (TypeIndex.size_override, no string tables),
+        so serving must synthesize the canonical name rather than index
+        an empty tuple."""
+        if i < len(self.index.ids):
+            return self.index.ids[i], self.index.labels[i]
+        return f"{self.node_type}_{i}", f"{self.node_type}_{i}"
+
+    def topk(self, source: str | None = None, source_id: str | None = None,
+             row: int | None = None, k: int | None = None):
+        """Synchronous top-k by label / id / row, resolved to ids:
+        list of (target_id, target_label, score)."""
+        r = self.resolve(source=source, source_id=source_id, row=row)
+        vals, idxs = self.topk_index(r, k)
+        return [
+            (*self._ident(int(i)), float(v))
+            for v, i in zip(vals, idxs)
+            if np.isfinite(v)
+        ]
+
+    def scores_index(self, row: int) -> np.ndarray:
+        """Full normalized score row (self pair included, as the
+        driver's all-pairs row would have it). Tile-cache hit or one
+        coalesced dispatch."""
+        row = int(row)
+        # copies on the hit paths: callers mutate score rows (self-
+        # masking is the natural first move), and handing out the
+        # cache's own array would poison every later tier-2 hit
+        srow = self.tile_cache.get_row(self._epoch, row)
+        if srow is not None:
+            return srow.copy()
+        # ride the normal dispatch path (fills the tile cache), then
+        # read the row back out of it
+        self.topk_index(row, self.config.k_default)
+        srow = self.tile_cache.get_row(self._epoch, row)
+        if srow is not None:
+            return srow.copy()
+        # tile cache disabled (budget 0): compute directly
+        return self.backend.scores_rows(
+            np.asarray([row]), variant=self.variant
+        )[0]
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def invalidate(self) -> None:
+        """Drop both cache tiers (explicit operator action or reload)."""
+        self.result_cache.clear()
+        self.tile_cache.clear()
+        runtime_event("serve_invalidate", fingerprint=self._fp)
+
+    def reload(self, backend: PathSimBackend) -> None:
+        """Swap in a freshly built backend (graph reload): drain the
+        in-flight pipeline, install + rewarm, invalidate both cache
+        tiers. Queries submitted after return are answered from the new
+        graph — and the epoch key guarantees no stale entry can ever be
+        served even across the swap."""
+        with self._swap_lock:
+            self.coalescer.drain()
+            old_fp = self._fp
+            self._install_backend(backend, warm=self.config.warm)
+            self.invalidate()
+            runtime_event(
+                "serve_reload", from_fingerprint=old_fp,
+                to_fingerprint=self._fp,
+            )
+
+    def stats(self) -> dict:
+        c = self.coalescer
+        batches = max(c.batch_count, 1)
+        return {
+            "n": self.n,
+            "metapath": self.metapath.name,
+            "variant": self.variant,
+            "backend": self.backend.name,
+            "fingerprint": self._fp,
+            "result_cache": {
+                "hits": self.result_cache.hits,
+                "misses": self.result_cache.misses,
+                "entries": len(self.result_cache),
+                "evictions": self.result_cache.evictions,
+            },
+            "tile_cache": {
+                "hits": self.tile_cache.hits,
+                "misses": self.tile_cache.misses,
+                "bytes": self.tile_cache.bytes_used,
+                "evictions": self.tile_cache.evictions,
+            },
+            "dispatch": {
+                "batches": c.batch_count,
+                "requests": c.dispatched_requests,
+                "shed": c.shed_count,
+                "mean_batch": round(c.dispatched_requests / batches, 3),
+                "mean_wait_ms": round(self._wait_ms_sum / batches, 3),
+                "buckets": dict(sorted(self._bucket_hist.items())),
+            },
+        }
+
+    def close(self) -> None:
+        self.coalescer.close()
+
+
+def build_service(
+    config,
+    serve_config: ServeConfig | None = None,
+    timer=None,
+):
+    """RunConfig → warm PathSimService (engine bootstrap + serving
+    wrap): the one-call path the ``serve`` CLI and the load generator
+    share."""
+    from ..engine import build_backend
+
+    t0 = time.perf_counter()
+    _, _, backend = build_backend(config, timer=timer)
+    service = PathSimService(
+        backend, variant=config.variant, config=serve_config
+    )
+    runtime_event(
+        "serve_ready",
+        backend=backend.name,
+        n=service.n,
+        metapath=service.metapath.name,
+        startup_s=round(time.perf_counter() - t0, 3),
+    )
+    return service

@@ -47,15 +47,21 @@ def set_event_sink(logger: "RunLogger | None") -> None:
     _EVENT_SINK = logger
 
 
-def runtime_event(event: str, **fields: Any) -> None:
+def runtime_event(event: str, echo: bool = True, **fields: Any) -> None:
     """Emit one structured resilience/runtime event.
 
     stderr rendering: ``[pathsim:EVENT] k=v k=v``; machine rendering: a
     metrics-JSONL record ``{"event": EVENT, ...fields}`` on the
     registered sink. Values are stringified for stderr but passed
-    through for JSONL (callers pre-repr exceptions)."""
-    rendered = " ".join(f"{k}={v}" for k, v in fields.items())
-    print(f"[pathsim:{event}] {rendered}".rstrip(), file=sys.stderr)
+    through for JSONL (callers pre-repr exceptions).
+
+    ``echo=False`` suppresses only the stderr line (the JSONL record
+    always lands): high-rate serving events (per-batch accounting,
+    sustained load shedding) must not turn the operator channel into
+    the bottleneck, but still need to be machine-visible."""
+    if echo:
+        rendered = " ".join(f"{k}={v}" for k, v in fields.items())
+        print(f"[pathsim:{event}] {rendered}".rstrip(), file=sys.stderr)
     sink = _EVENT_SINK
     if sink is not None:
         sink.metric(event=event, **fields)

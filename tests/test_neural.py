@@ -198,6 +198,30 @@ def test_rerank_rejects_unknown_index(hin):
 
 
 @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
+def test_hard_pool_rows_shuffled_under_mesh(hin):
+    """ADVICE r5: hard-pool sources are assembled at the FRONT of the
+    batch; under a dp mesh (contiguous source-axis shards) they must be
+    shuffled so they don't all land on the low-index devices."""
+    model = NeuralPathSim(
+        hin, "APVPA", dim=8, hidden=16, seed=0, mesh=make_mesh(8)
+    )
+    pool_src = np.arange(190, 200)
+    model.set_hard_pool(pool_src, np.tile(np.arange(4), (10, 1)))
+    b = 16  # batch_size 512 / SLATE 32
+    hard_rows = int(round(b * model.HARD_FRAC))
+    src, cand, tgt = model.sample_batch(512, np.random.default_rng(0))
+    assert src.shape[0] == b and len(cand) == len(src) == len(tgt)
+    in_pool = np.isin(src, pool_src)
+    assert in_pool.sum() >= hard_rows  # pool rows actually drawn
+    # the pool draw must NOT sit as the exact front block (the
+    # un-shuffled layout): fixed seed → deterministic assertion
+    front_block_only = (
+        in_pool[:hard_rows].all() and not in_pool[hard_rows:].any()
+    )
+    assert not front_block_only
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
 def test_sharded_small_batch_rounds_up(hin):
     """batch_size below SLATE·n_devices must train (source axis rounds
     up to a device multiple), not crash the dp-sharding divisibility."""

@@ -1,0 +1,371 @@
+"""Serving layer: batched row API, coalescer, cache tiers, protocol.
+
+The load-bearing guarantees:
+
+- batched/bucketed dispatch is BIT-identical to the unbatched
+  ``topk_row`` path (same scores, same tie ordering) on every backend;
+- the coalescer routes each concurrent submitter's result to the right
+  future;
+- cache tiers hit/miss/invalidate correctly, including across a graph
+  reload;
+- admission control sheds at the queue bound with a structured event.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributed_pathsim_tpu.backends.base import create_backend
+from distributed_pathsim_tpu.data.synthetic import synthetic_hin
+from distributed_pathsim_tpu.ops.metapath import compile_metapath
+from distributed_pathsim_tpu.serving import (
+    LoadShedError,
+    PathSimService,
+    ServeConfig,
+    graph_fingerprint,
+)
+
+BACKENDS = ["numpy", "jax", "jax-sparse", "jax-sharded"]
+
+
+@pytest.fixture(scope="module")
+def hin():
+    return synthetic_hin(160, 260, 9, n_topics=4, seed=7)
+
+
+@pytest.fixture(scope="module")
+def metapath(hin):
+    return compile_metapath("APVPA", hin.schema)
+
+
+@pytest.fixture(scope="module")
+def oracle(hin, metapath):
+    return create_backend("numpy", hin, metapath)
+
+
+def _service(hin, metapath, backend_name="numpy", **cfg):
+    cfg.setdefault("max_wait_ms", 5.0)
+    cfg.setdefault("warm", False)  # per-test services: skip warm loops
+    backend = create_backend(backend_name, hin, metapath)
+    return PathSimService(backend, config=ServeConfig(**cfg))
+
+
+# -- batched multi-row backend API (satellite: all-backend parity) --------
+
+
+@pytest.mark.parametrize("backend_name", BACKENDS)
+def test_topk_rows_matches_topk_row(hin, metapath, backend_name):
+    """Batched topk_rows must agree bit-for-bit (values AND tie order)
+    with per-row topk_row — duplicates in the batch included."""
+    b = create_backend(backend_name, hin, metapath)
+    rows = np.array([0, 3, 17, 99, 3, 159])
+    bv, bi = b.topk_rows(rows, k=7)
+    for j, r in enumerate(rows):
+        sv, si = b.topk_row(int(r), k=7)
+        assert np.array_equal(bv[j], sv), (backend_name, r)
+        assert np.array_equal(bi[j], si), (backend_name, r)
+
+
+@pytest.mark.parametrize("backend_name", BACKENDS)
+def test_topk_row_matches_argsort_oracle(hin, metapath, backend_name):
+    """topk_row's selection = stable argsort of the f64 score row
+    (descending score, ascending column among ties)."""
+    b = create_backend(backend_name, hin, metapath)
+    for r in (0, 42, 111):
+        s = np.asarray(b.scores_from_source(r), dtype=np.float64).copy()
+        s[r] = -np.inf
+        order = np.argsort(-s, kind="stable")[:7]
+        vals, idxs = b.topk_row(r, k=7)
+        assert np.array_equal(idxs, order)
+        assert np.array_equal(vals, s[order])
+
+
+def test_bucket_padding_never_changes_scores(hin, metapath, oracle):
+    """Power-of-two padding is semantically inert: every real row of a
+    padded batch equals its unbatched result exactly."""
+    from distributed_pathsim_tpu.serving.buckets import (
+        bucket_for,
+        bucket_ladder,
+        pad_rows,
+    )
+
+    assert bucket_ladder(32) == (1, 2, 4, 8, 16, 32)
+    assert bucket_ladder(5) == (1, 2, 4, 8)
+    assert bucket_for(3, (1, 2, 4, 8)) == 4
+    with pytest.raises(ValueError):
+        bucket_for(9, (1, 2, 4, 8))
+
+    b = create_backend("jax", hin, metapath)
+    for rows in ([5], [5, 9, 31], [1, 2, 3, 4, 5]):
+        rows = np.asarray(rows)
+        bucket = bucket_for(len(rows), bucket_ladder(8))
+        padded = pad_rows(rows, bucket)
+        assert padded.shape[0] == bucket
+        pv, pi = b.topk_rows(padded, k=6)
+        for j, r in enumerate(rows):
+            sv, si = b.topk_row(int(r), k=6)
+            assert np.array_equal(pv[j], sv)
+            assert np.array_equal(pi[j], si)
+
+
+def test_multipath_topk_rows_matches_topk_row(hin):
+    from distributed_pathsim_tpu.models.multipath import MultiMetapathScorer
+
+    sc = MultiMetapathScorer(hin, ["APVPA", "APA"])
+    rows = np.array([0, 12, 77, 12])
+    bv, bi = sc.topk_rows(rows, k=5, weights=[0.7, 0.3])
+    for j, r in enumerate(rows):
+        sv, si = sc.topk_row(int(r), k=5, weights=[0.7, 0.3])
+        assert np.array_equal(bv[j], sv)
+        assert np.array_equal(bi[j], si)
+
+
+# -- coalescer ------------------------------------------------------------
+
+
+def test_coalescer_concurrent_submitters_route_correctly(
+    hin, metapath, oracle
+):
+    """Concurrent clients through one service: every future resolves to
+    ITS row's oracle answer, and coalescing actually happened."""
+    svc = _service(hin, metapath, "jax", max_batch=8,
+                   cache_entries=0, tile_cache_bytes=0)
+    try:
+        rows = [i % 60 for i in range(48)]  # includes duplicates
+        results: dict[int, tuple] = {}
+
+        def worker(slot, r):
+            results[slot] = svc.topk_index(r, k=6)
+
+        threads = [
+            threading.Thread(target=worker, args=(slot, r))
+            for slot, r in enumerate(rows)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for slot, r in enumerate(rows):
+            ov, oi = oracle.topk_row(r, k=6)
+            av, ai = results[slot]
+            assert np.array_equal(av, ov), (slot, r)
+            assert np.array_equal(ai, oi), (slot, r)
+        st = svc.stats()["dispatch"]
+        assert st["requests"] == len(rows)
+        assert st["batches"] < len(rows)  # some coalescing happened
+        assert st["shed"] == 0
+    finally:
+        svc.close()
+
+
+def test_load_shedding_at_queue_bound(hin, metapath, tmp_path):
+    """A full queue sheds immediately with a structured event; admitted
+    requests still complete correctly."""
+    from distributed_pathsim_tpu.utils.logging import (
+        RunLogger,
+        set_event_sink,
+    )
+
+    svc = _service(hin, metapath, "numpy", max_batch=1, max_wait_ms=0.0,
+                   queue_depth=2, cache_entries=0, tile_cache_bytes=0)
+    # Make every dispatch slow so the queue actually backs up.
+    real = svc.backend.pairwise_rows
+
+    def slow(rows):
+        time.sleep(0.05)
+        return real(rows)
+
+    svc.backend.pairwise_rows = slow
+    metrics = tmp_path / "events.jsonl"
+    logger = RunLogger(output_path=None, echo=False,
+                       metrics_path=str(metrics))
+    set_event_sink(logger)
+    try:
+        futures, shed = [], 0
+        for i in range(20):
+            try:
+                futures.append((i, svc.submit_topk(i, k=3)))
+            except LoadShedError:
+                shed += 1
+        assert shed > 0
+        assert svc.stats()["dispatch"]["shed"] == shed
+        for i, fut in futures:
+            vals, idxs = fut.result(timeout=30)
+            sv, si = svc.backend.topk_row(i, k=3)
+            assert np.array_equal(vals, sv) and np.array_equal(idxs, si)
+    finally:
+        set_event_sink(None)
+        logger.close()
+        svc.close()
+    events = [json.loads(line) for line in metrics.read_text().splitlines()]
+    sheds = [e for e in events if e["event"] == "serve_shed"]
+    assert sheds and sheds[0]["depth"] == 2
+
+
+# -- cache tiers ----------------------------------------------------------
+
+
+def test_result_cache_hit_miss_and_invalidate(hin, metapath):
+    svc = _service(hin, metapath, "numpy", max_batch=4)
+    try:
+        v1, i1 = svc.topk_index(7, k=5)
+        s = svc.stats()["result_cache"]
+        assert s["misses"] >= 1 and s["hits"] == 0
+        v2, i2 = svc.topk_index(7, k=5)
+        assert svc.stats()["result_cache"]["hits"] == 1
+        assert np.array_equal(v1, v2) and np.array_equal(i1, i2)
+        svc.invalidate()
+        assert len(svc.result_cache) == 0
+        v3, _ = svc.topk_index(7, k=5)
+        assert np.array_equal(v3, v1)  # same graph → same answer
+    finally:
+        svc.close()
+
+
+def test_tile_cache_serves_other_k_without_dispatch(hin, metapath):
+    """Tier 2: a known score row answers a different k with zero new
+    dispatches (the k is not in the tile key)."""
+    svc = _service(hin, metapath, "numpy", max_batch=4)
+    try:
+        svc.topk_index(11, k=5)
+        batches = svc.stats()["dispatch"]["batches"]
+        vals, idxs = svc.topk_index(11, k=9)  # larger k: tier-1 miss
+        assert svc.stats()["dispatch"]["batches"] == batches
+        sv, si = svc.backend.topk_row(11, k=9)
+        assert np.array_equal(vals, sv) and np.array_equal(idxs, si)
+        assert svc.stats()["tile_cache"]["hits"] >= 1
+    finally:
+        svc.close()
+
+
+def test_cache_invalidation_on_graph_reload(metapath):
+    """Reload with a DIFFERENT graph: fingerprint changes, caches
+    cleared, answers come from the new graph."""
+    hin_a = synthetic_hin(120, 200, 8, n_topics=3, seed=1)
+    hin_b = synthetic_hin(120, 200, 8, n_topics=3, seed=2)
+    mp = compile_metapath("APVPA", hin_a.schema)
+    assert graph_fingerprint(hin_a) != graph_fingerprint(hin_b)
+    svc = _service(hin_a, mp, "numpy", max_batch=4)
+    try:
+        va, _ = svc.topk_index(5, k=5)
+        fp_a = svc.stats()["fingerprint"]
+        svc.reload(create_backend("numpy", hin_b, mp))
+        assert svc.stats()["fingerprint"] != fp_a
+        assert len(svc.result_cache) == 0
+        vb, ib = svc.topk_index(5, k=5)
+        ov, oi = create_backend("numpy", hin_b, mp).topk_row(5, k=5)
+        assert np.array_equal(vb, ov) and np.array_equal(ib, oi)
+        assert not np.array_equal(va, vb)  # different graph, new answers
+    finally:
+        svc.close()
+
+
+def test_scores_index_matches_scores_from_source(hin, metapath, oracle):
+    svc = _service(hin, metapath, "numpy", max_batch=2)
+    try:
+        row = 23
+        got = svc.scores_index(row)
+        want = oracle.scores_from_source(row)
+        assert np.array_equal(got, want)
+    finally:
+        svc.close()
+
+
+# -- warm compile (satellite) ---------------------------------------------
+
+
+def test_warm_compile_cache_emits_bucket_events(hin, metapath, tmp_path):
+    from distributed_pathsim_tpu.utils.logging import (
+        RunLogger,
+        set_event_sink,
+    )
+    from distributed_pathsim_tpu.utils.xla_flags import warm_compile_cache
+
+    backend = create_backend("jax", hin, metapath)
+    metrics = tmp_path / "warm.jsonl"
+    logger = RunLogger(output_path=None, echo=False,
+                       metrics_path=str(metrics))
+    set_event_sink(logger)
+    try:
+        times = warm_compile_cache(backend, (1, 2, 4), k=3)
+    finally:
+        set_event_sink(None)
+        logger.close()
+    assert sorted(times) == [1, 2, 4]
+    events = [json.loads(line) for line in metrics.read_text().splitlines()]
+    warm = [e for e in events if e["event"] == "compile_warm"]
+    assert [e["bucket"] for e in warm] == [1, 2, 4]
+    assert all(e["seconds"] >= 0 for e in warm)
+
+
+# -- JSONL protocol -------------------------------------------------------
+
+
+def test_protocol_requests_and_serve_loop(hin, metapath):
+    from distributed_pathsim_tpu.serving.protocol import (
+        handle_request,
+        serve_loop,
+    )
+
+    svc = _service(hin, metapath, "numpy", max_batch=4)
+    try:
+        assert handle_request(svc, {"id": 1, "op": "ping"})["ok"]
+        resp = handle_request(svc, {"id": 2, "op": "topk", "row": 5, "k": 3})
+        assert resp["ok"] and len(resp["result"]["topk"]) == 3
+        sv, si = svc.backend.topk_row(5, k=3)
+        assert [t["score"] for t in resp["result"]["topk"]] == sv.tolist()
+        bad = handle_request(svc, {"id": 3, "op": "nope"})
+        assert not bad["ok"] and "unknown op" in bad["error"]
+        missing = handle_request(svc, {"id": 4, "op": "topk"})
+        assert not missing["ok"]
+        scores = handle_request(svc, {"id": 5, "op": "scores", "row": 5})
+        assert scores["ok"] and len(scores["result"]["scores"]) == svc.n
+
+        out = io.StringIO()
+        rc = serve_loop(
+            svc,
+            io.StringIO(
+                '{"id": 10, "op": "stats"}\n'
+                "not json\n"
+                '{"id": 11, "op": "shutdown"}\n'
+                '{"id": 12, "op": "ping"}\n'  # after shutdown: unread
+            ),
+            out,
+        )
+        assert rc == 0
+        lines = [json.loads(line) for line in out.getvalue().splitlines()]
+        assert len(lines) == 3  # stats, bad-json error, shutdown ack
+        assert lines[0]["ok"] and lines[0]["result"]["n"] == svc.n
+        assert not lines[1]["ok"]
+        assert lines[2]["result"] == {"shutdown": True}
+    finally:
+        svc.close()
+
+
+# -- serve smoke (satellite: CI gate, non-slow) ---------------------------
+
+
+def test_bench_serving_smoke(tmp_path):
+    """``make serve-smoke`` in-process: warm-cache p50 beats cold-cache
+    p50 and nothing sheds, on a small fixed-seed synthetic graph."""
+    import pathlib
+    import sys
+
+    repo = str(pathlib.Path(__file__).resolve().parents[1])
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    import bench_serving
+
+    result = bench_serving.run_smoke(str(tmp_path / "smoke.json"))
+    assert result["smoke_checks"]["warm_p50_lt_cold_p50"]
+    assert result["smoke_checks"]["zero_shed"]
+    r = result["regimes"]
+    # directionally: batching beats serial dispatch on the same graph
+    assert r["cold"]["qps"] > r["serial"]["qps"]
+    assert (tmp_path / "smoke.json").exists()
