@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: test chaos serve-smoke
+.PHONY: test chaos serve-smoke update-smoke
 
 # Tier-1: the fast CPU suite (the driver's acceptance gate).
 test:
@@ -22,3 +22,13 @@ chaos:
 # (tests/test_serving.py::test_bench_serving_smoke), so tier-1 covers it.
 serve-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) bench_serving.py --smoke
+
+# Delta-ingestion smoke: a warm service absorbing Δ ≤ 1% of edges must
+# be ≥10× faster end-to-end than the reload path (GEXF reparse +
+# re-encode + rebuild + rewarm), issue ZERO new XLA compiles in steady
+# state (CompileCounter hook), and keep every unaffected row's cache
+# entries. The same run is wired as a non-slow pytest
+# (tests/test_delta.py::test_bench_update_smoke), so tier-1 covers it.
+update-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) bench_serving.py --regime update --smoke \
+		--out BENCH_SERVING_UPDATE_r07.json

@@ -11,6 +11,13 @@ fixed seed:
   tiers buy on a repeated-query workload (Atrapos's observation);
 - **mixed**: 50% hot / 50% cold-miss traffic — the honest in-between.
 
+``--regime update`` measures the delta-ingestion engine instead
+(data/delta.py): update-to-fresh-answer latency of a warm service
+absorbing Δ-edge batches via ``service.update`` versus the ``reload``
+path (fresh backend build + swap), plus the two hard contracts — zero
+new XLA compiles in steady state (CompileCounter) and retention of
+every unaffected row's cache entries.
+
 Each regime runs C closed-loop clients (every client issues its next
 query only after the previous answer returns — QPS is an output, not an
 input), reports QPS and p50/p95/p99 latency, and the JSON artifact
@@ -192,6 +199,208 @@ def run_bench(
     return out
 
 
+def _random_delta(hin, rng, edge_frac: float, append_nodes: bool):
+    """A Δ batch touching ``edge_frac`` of the author_of edges (half
+    adds of fresh pairs, half removes of existing ones), optionally
+    with an author append wired in by an added edge."""
+    from distributed_pathsim_tpu.data import delta as dl
+
+    ap = hin.blocks["author_of"]
+    n_auth = hin.type_size("author")
+    n_pap = hin.type_size("paper")
+    total_edges = sum(b.nnz for b in hin.blocks.values())
+    n_changes = max(2, int(edge_frac * total_edges))
+    n_rem = n_changes // 2
+    rem_i = rng.choice(ap.nnz, size=n_rem, replace=False)
+    removes = np.stack([ap.rows[rem_i], ap.cols[rem_i]], axis=1)
+    # keep removed pairs in the exclusion set: an add colliding with a
+    # remove is a malformed batch apply_delta rejects
+    existing = set(zip(ap.rows.tolist(), ap.cols.tolist()))
+    adds = []
+    nodes = ()
+    if append_nodes:
+        # one appended author, wired in by this batch's first add
+        if hin.indices["author"].size_override is None:
+            nodes = (
+                dl.NodeAppend(
+                    node_type="author", ids=(f"author_{n_auth}",)
+                ),
+            )
+        else:
+            nodes = (dl.NodeAppend(node_type="author", count=1),)
+        adds.append((n_auth, int(rng.integers(0, n_pap))))
+    while len(adds) < n_changes - n_rem:
+        e = (int(rng.integers(0, n_auth)), int(rng.integers(0, n_pap)))
+        if e not in existing:
+            existing.add(e)
+            adds.append(e)
+    return dl.DeltaBatch(
+        edges=(dl.edge_delta("author_of", add=adds, remove=removes),),
+        nodes=nodes,
+    )
+
+
+def run_update_bench(
+    n_authors: int = 2048,
+    n_papers: int = 4096,
+    n_venues: int = 48,
+    edge_frac: float = 0.01,
+    reps: int = 5,
+    k: int = 10,
+    backend: str = "jax",
+    headroom: float = 0.25,
+    seed: int = 0,
+) -> dict:
+    """Update-to-fresh-answer latency: ``service.update`` (delta patch)
+    vs the reload path, each followed by one query for a row the change
+    affected. The reload timing covers what the production ``reload``
+    op actually runs end-to-end — loader + encode (``synthetic_hin`` is
+    this graph's loader; the DBLP GEXF reparse it stands in for is far
+    costlier), headroom padding, fresh backend build, swap + rewarm +
+    total cache flush — because that is exactly the work a graph change
+    forced before deltas existed. Also checks the two hard contracts:
+    zero new XLA compiles across steady-state updates, and cache
+    retention for every unaffected row."""
+    import tempfile
+
+    from distributed_pathsim_tpu.backends.base import create_backend
+    from distributed_pathsim_tpu.data import delta as dl
+    from distributed_pathsim_tpu.data.encode import encode_hin
+    from distributed_pathsim_tpu.data.gexf import read_gexf
+    from distributed_pathsim_tpu.data.synthetic import (
+        DBLP_SCHEMA, synthetic_hin, write_gexf,
+    )
+    from distributed_pathsim_tpu.ops.metapath import compile_metapath
+    from distributed_pathsim_tpu.serving import PathSimService, ServeConfig
+    from distributed_pathsim_tpu.utils.xla_flags import CompileCounter
+
+    rng = np.random.default_rng(seed)
+    # materialized ids so the graph round-trips through GEXF — the
+    # reload baseline below re-runs the real loader on a real file
+    hin = dl.with_headroom(
+        synthetic_hin(n_authors, n_papers, n_venues, seed=seed,
+                      materialize_ids=True),
+        headroom,
+    )
+    gexf_dir = tempfile.mkdtemp(prefix="dpathsim_bench_")
+    gexf_path = f"{gexf_dir}/serving_graph.gexf"
+    write_gexf(hin, gexf_path)
+    mp = compile_metapath("APVPA", hin.schema)
+    svc = PathSimService(
+        create_backend(backend, hin, mp),
+        # near-zero linger: single-probe latencies should measure the
+        # update/reload machinery, not the batch-former's straggler wait
+        config=ServeConfig(max_batch=8, k_default=k, max_wait_ms=0.1),
+    )
+    try:
+        # ---- cache retention: warm a working set, apply one delta,
+        # every unaffected row must still answer from tier 1 ----------
+        working_set = rng.choice(n_authors, size=128, replace=False)
+        for r in working_set:
+            svc.topk_index(int(r), k=k)
+        delta = _random_delta(svc.hin, rng, edge_frac, append_nodes=True)
+        info0 = svc.update(delta)  # warmup update: compiles delta progs
+        if info0["mode"] != "delta":
+            raise AssertionError(f"warmup update fell back: {info0}")
+        affected = info0["affected_rows"]
+        # re-query the working set; count tier-1 hits
+        h0 = svc.stats()["result_cache"]["hits"]
+        unaffected_hits = 0
+        for r in working_set:
+            before = svc.stats()["result_cache"]["hits"]
+            svc.topk_index(int(r), k=k)
+            unaffected_hits += svc.stats()["result_cache"]["hits"] - before
+        retained = {
+            "working_set": int(working_set.shape[0]),
+            "affected_rows": int(affected),
+            "tier1_hits_after_update": int(
+                svc.stats()["result_cache"]["hits"] - h0
+            ),
+            "unaffected_in_set_retained": unaffected_hits,
+        }
+
+        # ---- steady state: updates + fresh-answer queries, counting
+        # compiles the whole time -------------------------------------
+        t_update = []
+        with CompileCounter() as cc:
+            for i in range(reps):
+                delta = _random_delta(
+                    svc.hin, rng, edge_frac, append_nodes=(i % 2 == 0)
+                )
+                probe = int(delta.edges[0].add[0][0])  # an affected row
+                t0 = time.perf_counter()
+                info = svc.update(delta)
+                svc.topk_index(min(probe, svc.n - 1), k=k)
+                t_update.append(time.perf_counter() - t0)
+                if info["mode"] != "delta":
+                    raise AssertionError(f"steady-state fallback: {info}")
+            compiles = cc.count
+
+        # ---- the old world: the full reload path — GEXF reparse,
+        # re-encode, re-pad, fresh backend build, swap (rewarm + total
+        # cache flush), first fresh answer. Exactly the work PR 2's
+        # serving layer forced on ANY graph change. -------------------
+        t_reload = []
+        for i in range(reps):
+            probe = int(rng.integers(0, n_authors))
+            t0 = time.perf_counter()
+            hin_r = dl.with_headroom(
+                encode_hin(read_gexf(gexf_path), DBLP_SCHEMA), headroom
+            )
+            svc.reload(create_backend(backend, hin_r, mp))
+            svc.topk_index(probe, k=k)
+            t_reload.append(time.perf_counter() - t0)
+
+        upd_ms = sorted(1e3 * t for t in t_update)
+        rel_ms = sorted(1e3 * t for t in t_reload)
+        med_upd = upd_ms[len(upd_ms) // 2]
+        med_rel = rel_ms[len(rel_ms) // 2]
+        return {
+            "graph": {"authors": n_authors, "papers": n_papers,
+                      "venues": n_venues, "seed": seed,
+                      "headroom": headroom},
+            "load": {"edge_frac": edge_frac, "reps": reps, "k": k},
+            "backend": backend,
+            "update_ms": {"median": round(med_upd, 3),
+                          "min": round(upd_ms[0], 3),
+                          "max": round(upd_ms[-1], 3)},
+            "reload_ms": {"median": round(med_rel, 3),
+                          "min": round(rel_ms[0], 3),
+                          "max": round(rel_ms[-1], 3)},
+            "speedup_vs_reload": round(med_rel / med_upd, 2),
+            "steady_state_compiles": compiles,
+            "cache_retention": retained,
+            "service": svc.stats()["delta"],
+        }
+    finally:
+        svc.close()
+
+
+def run_update_smoke(out_path: str | None = None) -> dict:
+    """The acceptance run: 2048-author graph, Δ ≤ 1% of edges, with
+    three hard gates — ≥10× faster than reload, zero steady-state
+    compiles, and full cache retention for unaffected rows."""
+    result = run_update_bench()
+    ret = result["cache_retention"]
+    checks = {
+        "speedup_ge_10x": result["speedup_vs_reload"] >= 10.0,
+        "zero_steady_state_compiles": result["steady_state_compiles"] == 0,
+        # every working-set row outside the affected set must hit tier 1
+        "unaffected_rows_retained": (
+            ret["unaffected_in_set_retained"]
+            >= ret["working_set"]
+            - min(ret["affected_rows"], ret["working_set"])
+        ),
+    }
+    result["smoke_checks"] = checks
+    if out_path:
+        with open(out_path, "w", encoding="utf-8") as f:
+            json.dump(result, f, indent=2)
+    if not all(checks.values()):
+        raise AssertionError(f"update smoke failed: {checks}")
+    return result
+
+
 def run_smoke(out_path: str | None = None) -> dict:
     """Small fixed-seed run with the two hard gates tier-1 enforces."""
     result = run_bench(
@@ -220,6 +429,15 @@ def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--smoke", action="store_true",
                    help="small fixed run with hard pass/fail gates")
+    p.add_argument("--regime", default="load", choices=("load", "update"),
+                   help="'load': the closed-loop QPS regimes; 'update': "
+                   "delta-ingestion vs reload latency")
+    p.add_argument("--edge-frac", type=float, default=0.01,
+                   help="update regime: fraction of edges per Δ batch")
+    p.add_argument("--reps", type=int, default=5,
+                   help="update regime: measured update/reload pairs")
+    p.add_argument("--headroom", type=float, default=0.25,
+                   help="update regime: index-capacity reserve")
     p.add_argument("--authors", type=int, default=2048)
     p.add_argument("--papers", type=int, default=4096)
     p.add_argument("--venues", type=int, default=48)
@@ -233,7 +451,20 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--out", default=None, help="write the JSON here")
     args = p.parse_args(argv)
 
-    if args.smoke:
+    if args.regime == "update":
+        if args.smoke:
+            result = run_update_smoke(args.out)
+        else:
+            result = run_update_bench(
+                n_authors=args.authors, n_papers=args.papers,
+                n_venues=args.venues, edge_frac=args.edge_frac,
+                reps=args.reps, k=args.k, backend=args.backend,
+                headroom=args.headroom, seed=args.seed,
+            )
+            if args.out:
+                with open(args.out, "w", encoding="utf-8") as f:
+                    json.dump(result, f, indent=2)
+    elif args.smoke:
         result = run_smoke(args.out)
     else:
         result = run_bench(

@@ -26,8 +26,24 @@ from ..ops.metapath import MetaPath
 from ..ops import pathsim
 
 
+class DeltaUnsupported(RuntimeError):
+    """The backend has no incremental-update path for this chain shape
+    (e.g. an asymmetric metapath: no half factor to patch). Callers fall
+    back to a full rebuild — a capability miss, never a correctness
+    failure."""
+
+
 class PathSimBackend(abc.ABC):
-    """Common surface for all execution backends."""
+    """Common surface for all execution backends.
+
+    **Capacity invariant**: the bound HIN may reserve index headroom
+    (data/delta.py) — adjacency blocks then have padded shapes and the
+    backend's device/host arrays are built at capacity. Every
+    host-visible result (rows, sums, matrices, scores) is trimmed to
+    the LOGICAL size ``n_sources``, so padding is invisible to callers
+    and results are bit-identical to an unpadded build (padded factor
+    rows hold no edges, so they contribute zero counts everywhere).
+    """
 
     name: str = "abstract"
 
@@ -35,6 +51,18 @@ class PathSimBackend(abc.ABC):
         self.hin = hin
         self.metapath = metapath
         self.options = options
+
+    @property
+    def n_sources(self) -> int:
+        """Logical source-node count (never the padded capacity).
+        Read dynamically: a delta update can append nodes."""
+        return self.hin.type_size(self.metapath.source_type)
+
+    @property
+    def n_targets(self) -> int:
+        """Logical target-node count (== n_sources for symmetric
+        chains; the column-axis trim for asymmetric ones)."""
+        return self.hin.type_size(self.metapath.target_type)
 
     # -- primitives (each backend implements) -----------------------------
 
@@ -128,6 +156,32 @@ class PathSimBackend(abc.ABC):
             else None
         )
         return pathsim.score_matrix(m, rowsums=rowsums, variant=variant, xp=np)
+
+    # -- incremental updates (delta-ingestion engine, data/delta.py) -------
+
+    def apply_delta(self, plan) -> None:
+        """Absorb one :class:`~..data.delta.DeltaPlan` in place: patch
+        the half factor, denominators, and derived caches from the
+        plan's signed ΔC instead of rebuilding — O(Δ + affected rows),
+        zero new XLA compiles in steady state (every patched array keeps
+        its shape; that's what the capacity headroom buys).
+
+        Raises :class:`DeltaUnsupported` when this backend/chain has no
+        patch path; the caller (PathSimService.update) falls back to a
+        full rebuild. A ``fallback`` plan is a caller bug — the plan
+        already decided this delta must rebuild."""
+        if plan.fallback:
+            raise ValueError(
+                f"plan requires full rebuild ({plan.reason}); "
+                "apply_delta must not be called with a fallback plan"
+            )
+        impl = getattr(self, "_apply_delta_impl", None)
+        if impl is None:
+            raise DeltaUnsupported(
+                f"backend {self.name!r} has no incremental update path"
+            )
+        impl(plan)
+        self.hin = plan.hin_new
 
 
 _REGISTRY: dict[str, Callable[..., PathSimBackend]] = {}

@@ -60,6 +60,21 @@ def _m_from_half(c):
 
 
 @jax.jit
+def _apply_coo_delta(c, rows, cols, weights):
+    """Patch the device-resident factor with a signed COO delta. The
+    delta is padded to a power-of-two nnz (weight-0 entries scatter
+    harmlessly), so steady-state updates reuse one compiled program per
+    nnz bucket — the recompile-free-serving contract."""
+    return c.at[rows, cols].add(weights)
+
+
+@jax.jit
+def _rowsums_only(c):
+    with jax.default_matmul_precision("highest"):
+        return chain.rowsums_from_half(c, xp=jnp)
+
+
+@jax.jit
 def _diag_from_half(c):
     """diag(M)[i] = Σ_v C[i,v]² — the textbook-PathSim denominator,
     without materializing M."""
@@ -162,8 +177,11 @@ class JaxDenseBackend(PathSimBackend):
         if self.exact_counts:
             chain.check_exact_counts(rowsums.max(initial=0.0), self.dtype)
 
+    # Device/host caches stay at capacity shape; returns trim to the
+    # logical sizes (padded slots carry no edges → zero counts).
+
     def commuting_matrix(self) -> np.ndarray:
-        return self._compute()[0]
+        return self._compute()[0][: self.n_sources, : self.n_targets]
 
     def global_walks(self) -> np.ndarray:
         if self._rowsums is None and self._m is None:
@@ -176,7 +194,7 @@ class JaxDenseBackend(PathSimBackend):
             self._check_exact(self._rowsums)
         elif self._rowsums is None:
             self._compute()
-        return self._rowsums
+        return self._rowsums[: self.n_sources]
 
     def pairwise_row(self, source_index: int) -> np.ndarray:
         if self._symmetric:
@@ -193,8 +211,8 @@ class JaxDenseBackend(PathSimBackend):
             if self._rowsums is None:
                 self._rowsums = np.asarray(rowsums, dtype=np.float64)
                 self._check_exact(self._rowsums)
-            return np.asarray(row, dtype=np.float64)
-        return self._compute()[0][source_index]
+            return np.asarray(row, dtype=np.float64)[: self.n_targets]
+        return self._compute()[0][source_index, : self.n_targets]
 
     def pairwise_rows(self, rows) -> np.ndarray:
         """Batched M[rows, :] — host view of :meth:`pairwise_rows_device`
@@ -203,7 +221,7 @@ class JaxDenseBackend(PathSimBackend):
         out = self.pairwise_rows_device(rows)
         if out is None:
             return super().pairwise_rows(rows)
-        return np.asarray(out, dtype=np.float64)
+        return np.asarray(out, dtype=np.float64)[:, : self.n_targets]
 
     def pairwise_rows_device(self, rows):
         """Batched row counts as a DEVICE array (async dispatch: the
@@ -223,6 +241,43 @@ class JaxDenseBackend(PathSimBackend):
             self._rowsums = np.asarray(rowsums, dtype=np.float64)
             self._check_exact(self._rowsums)
         return out
+
+    def _apply_delta_impl(self, plan) -> None:
+        """Patch the device-resident half factor in place: one scatter
+        of the signed ΔC (padded to a power-of-two nnz bucket) plus one
+        rowsums GEMV — both shape-stable, so a warm service absorbs the
+        update with zero new XLA compiles in steady state. f32 adds of
+        small integers are exact below the 2^24 guard, so the patched
+        factor equals a rebuilt one bit-for-bit."""
+        from .base import DeltaUnsupported
+
+        if not self._symmetric:
+            raise DeltaUnsupported(
+                "jax backend patches only the symmetric half factor"
+            )
+        dc = plan.delta_c
+        nnz = int(dc.rows.shape[0])
+        bucket = max(8, 1 << (max(nnz, 1) - 1).bit_length())
+        rows = np.zeros(bucket, dtype=np.int32)
+        cols = np.zeros(bucket, dtype=np.int32)
+        w = np.zeros(bucket, dtype=np.float64)
+        rows[:nnz] = dc.rows
+        cols[:nnz] = dc.cols
+        w[:nnz] = dc.weights
+        c, _ = self._half()
+        c_new = _apply_coo_delta(
+            c,
+            jnp.asarray(rows),
+            jnp.asarray(cols),
+            jnp.asarray(w, dtype=self.dtype),
+        )
+        # _half_cache is the single authority for (C, rowsums) — the
+        # construction-time COO arrays are now stale and never re-read
+        # (only _half() consults them, and only while the cache is
+        # empty, which it never again is).
+        self._half_cache = (c_new, _rowsums_only(c_new))
+        self._m = None
+        self._rowsums = None  # next host fetch re-runs the exact guard
 
     # -- on-device scoring fast paths -------------------------------------
 
@@ -257,7 +312,8 @@ class JaxDenseBackend(PathSimBackend):
         if self._rowsums is None:
             self._rowsums = np.asarray(rowsums, dtype=np.float64)
             self._check_exact(self._rowsums)
-        return np.asarray(scores)
+        n = self.n_sources
+        return np.asarray(scores)[:n, :n]
 
     def topk(self, k: int = 10, mask_self: bool = True,
              variant: str = "rowsum"):
@@ -302,9 +358,14 @@ class JaxDenseBackend(PathSimBackend):
             self._rowsums = np.asarray(rowsums, dtype=np.float64)
             self._check_exact(self._rowsums)
         # One batched transfer for both outputs: on the tunneled TPU two
-        # np.asarray fetches are two ~70 ms round-trips.
+        # np.asarray fetches are two ~70 ms round-trips. Row trim drops
+        # capacity-padded sources; padded COLUMNS need no mask — their
+        # scores are exactly 0 (no edges → zero counts and denominator)
+        # and every real column ties at 0 with a LOWER index, so the
+        # ascending-index tie-break keeps them out whenever k ≤ n−1.
         vals_h, idxs_h = jax.device_get((vals, idxs))
-        return np.asarray(vals_h), np.asarray(idxs_h)
+        n = self.n_sources
+        return np.asarray(vals_h)[:n], np.asarray(idxs_h)[:n]
 
     # Row-tile width for the rect streaming path (halved until the
     # packed candidate buffer fits its HBM budget at large N).

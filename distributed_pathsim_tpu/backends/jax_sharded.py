@@ -91,16 +91,24 @@ class JaxShardedBackend(PathSimBackend):
         # no host ever materializes all of C, which is what the
         # million-author configuration requires. The sharded program then
         # starts at C (empty ``rest``): same collectives, far less data.
-        coo = sp.half_chain_coo(hin, metapath)
-        self._check_exact_coo(coo, dtype)
+        self._np_dtype = np.dtype(dtype)
+        self._install_coo(sp.half_chain_coo(hin, metapath))
+
+    def _install_coo(self, coo) -> None:
+        """Bind a (new) folded half-chain COO: exactness guard, host
+        sort, distributed dense assembly, derived-cache reset. Shared by
+        __init__ and the delta-update hook — a patched backend runs the
+        identical assembly a fresh build does (same sharded programs:
+        the factor's capacity shape never changes under a non-fallback
+        delta, so nothing recompiles)."""
+        np_dtype = self._np_dtype
+        self._check_exact_coo(coo, np_dtype)
         self._coo_shape = coo.shape
         self._coo_nnz = int(coo.rows.shape[0])
-        self._np_dtype = np.dtype(dtype)
         order = np.argsort(coo.rows, kind="stable")
         rows_s = coo.rows[order]
         cols_s = coo.cols[order]
         w_s = coo.weights[order]
-        np_dtype = np.dtype(dtype)
 
         def load_rows(a: int, b: int) -> np.ndarray:
             lo, hi = np.searchsorted(rows_s, [a, b])
@@ -116,8 +124,20 @@ class JaxShardedBackend(PathSimBackend):
         # hundreds of MB of COO on every no-checkpoint construction
         # would be pure startup waste
         self._coo_sorted = (rows_s, cols_s, w_s)
+        self._coo_digest_cache = None
         self._m: np.ndarray | None = None
         self._rowsums: np.ndarray | None = None
+
+    def _apply_delta_impl(self, plan) -> None:
+        """Re-install the plan's patched factor (ΔC from the delta-COO
+        product rule — no chain refold): one host re-sort plus the
+        host-local dense row assembly, reusing every compiled sharded
+        program (shapes pinned by the capacity invariant). The
+        distributed M/rowsums recompute lazily on the next query through
+        the exact same collectives a fresh build would run."""
+        self.hin = plan.hin_new
+        self.n = self.hin.type_size(self.metapath.source_type)
+        self._install_coo(plan.half_new)
 
     @property
     def _coo_digest(self) -> str:

@@ -63,9 +63,28 @@ class JaxSparseBackend(PathSimBackend):
         super().__init__(hin, metapath, **options)
         if not metapath.is_symmetric:
             raise ValueError("jax-sparse requires a symmetric metapath")
-        self._c = sp.half_chain_coo(hin, metapath)
-        self.n = self._c.shape[0]
         self.exact_counts = exact_counts
+        self._dtype = dtype
+        self._tile_rows_req = tile_rows
+        self._dense_c_budget = (
+            self._DENSE_C_DEVICE_BUDGET
+            if dense_c_budget_bytes is None
+            else int(dense_c_budget_bytes)
+        )
+        self._rect_kernel = rect_kernel
+        self._bind_factor(sp.half_chain_coo(hin, metapath))
+
+    def _bind_factor(self, coo) -> None:
+        """Bind a (new) half-chain factor: overflow-mode detection,
+        tiling, cache reset. __init__ and the delta-update hook share
+        this so a patched backend can never drift from a fresh build.
+        ``self.n`` is the LOGICAL source count — the factor's row axis
+        may be capacity-padded (data/delta.py headroom); padded rows
+        carry no entries and every sweep below is masked/trimmed to n.
+        """
+        self._c = coo
+        self.n = self.hin.type_size(self.metapath.source_type)
+        dtype = self._dtype
         # Overflow detection (same cheap-bound → tight-per-row ladder
         # the TiledHalfChain guard uses, but the outcome is a MODE, not
         # a refusal): d_i ≥ M[i,j] ≥ every partial sum (non-negative
@@ -73,10 +92,11 @@ class JaxSparseBackend(PathSimBackend):
         # whole f32 pipeline exact; past it the rescore phase restores
         # exactness.
         self._exact_rescore = False
+        self._host_rowsums = None
         from ..ops import chain as _chain
 
         if (
-            exact_counts
+            self.exact_counts
             and _chain.effective_device_dtype(dtype) == np.float32
         ):
             s = self._c
@@ -92,22 +112,41 @@ class JaxSparseBackend(PathSimBackend):
                     self._host_rowsums = rs
         self.tiled = sp.TiledHalfChain(
             self._c,
-            tile_rows=min(tile_rows, max(self.n, 8)),
+            # clamp to the factor's CAPACITY-padded row axis, not the
+            # logical n: n grows on node appends, and a tile shape tied
+            # to it would retrace every tile program per append —
+            # exactly the recompile the capacity invariant exists to
+            # prevent. coo.shape[0] is delta-stable by construction.
+            tile_rows=min(self._tile_rows_req, max(coo.shape[0], 8)),
             dtype=dtype,
             # in rescore mode the f32 tiles are a prefilter by design;
             # the tiled guard would refuse what the rescore phase fixes
-            exact_counts=exact_counts and not self._exact_rescore,
+            exact_counts=self.exact_counts and not self._exact_rescore,
         )
-        self._dense_c_budget = (
-            self._DENSE_C_DEVICE_BUDGET
-            if dense_c_budget_bytes is None
-            else int(dense_c_budget_bytes)
-        )
-        self._rect_kernel = rect_kernel
         self._rect_factor = None
         self._rowsums: np.ndarray | None = None
         self._diag: np.ndarray | None = None
         self._m: np.ndarray | None = None
+        self._c_sum = None
+        self._indptr = None
+
+    def _apply_delta_impl(self, plan) -> None:
+        """Rebind to the plan's already-patched COO factor (ΔC came
+        from the delta-COO product rule — the chain is never refolded)
+        and rebuild the tiling. Host cost is one O(nnz) re-sort; device
+        tiles re-densify lazily through the SAME compiled scatter
+        (tile_rows/V unchanged by the capacity invariant, scatter pad
+        in power-of-two buckets), so steady-state updates compile
+        nothing."""
+        self.hin = plan.hin_new  # logical n may have grown (appends)
+        self._bind_factor(plan.half_new)
+
+    @property
+    def _n_live_tiles(self) -> int:
+        """Row tiles that contain any LOGICAL row. Tiles past this hold
+        only capacity padding (no COO entries) — every sweep skips them;
+        the last live tile's padded tail rows are masked via n_true."""
+        return -(-self.n // self.tiled.tile_rows)
 
     def _use_rect_kernel(self, k: int) -> bool:
         """The rectangular Pallas kernel serves the f32 streaming regime
@@ -130,7 +169,7 @@ class JaxSparseBackend(PathSimBackend):
             # already computed by the overflow detector.
             self._rowsums = (
                 self._host_rowsums if self._exact_rescore
-                else self.tiled.rowsums()
+                else self.tiled.rowsums()[: self.n]
             )
         return self._rowsums
 
@@ -148,8 +187,8 @@ class JaxSparseBackend(PathSimBackend):
                 return self._m
             t = self.tiled
             m = np.zeros((t.n_tiles * t.tile_rows, t.n_tiles * t.tile_rows))
-            for i in range(t.n_tiles):
-                for j in range(i, t.n_tiles):
+            for i in range(self._n_live_tiles):
+                for j in range(i, self._n_live_tiles):
                     tile = np.asarray(t.m_tile(i, j), dtype=np.float64)
                     m[
                         i * t.tile_rows : (i + 1) * t.tile_rows,
@@ -170,7 +209,7 @@ class JaxSparseBackend(PathSimBackend):
         ti, off = divmod(source_index, t.tile_rows)
         src_tile = t.tile(ti)
         out = np.zeros(t.n_tiles * t.tile_rows, dtype=np.float64)
-        for j in range(t.n_tiles):
+        for j in range(self._n_live_tiles):
             tile = np.asarray(
                 sp.tile_outer(src_tile[off : off + 1], t.tile(j)),
                 dtype=np.float64,
@@ -196,7 +235,7 @@ class JaxSparseBackend(PathSimBackend):
         out = np.zeros(
             (rows.shape[0], t.n_tiles * t.tile_rows), dtype=np.float64
         )
-        for j in range(t.n_tiles):
+        for j in range(self._n_live_tiles):
             tile = np.asarray(sp.tile_outer(src, t.tile(j)), dtype=np.float64)
             out[:, j * t.tile_rows : (j + 1) * t.tile_rows] = tile
         return out[:, : self.n]
@@ -339,7 +378,7 @@ class JaxSparseBackend(PathSimBackend):
                     idxs=idxs[i0_ : i0_ + rows_],
                 )
 
-        for i in range(t.n_tiles):
+        for i in range(self._n_live_tiles):
             i0 = i * t.tile_rows
             rows_here = min(t.tile_rows, self.n - i0)
             key = f"topk{k}_rowtile_{i}"
@@ -437,7 +476,7 @@ class JaxSparseBackend(PathSimBackend):
         di = d_all[i0 : i0 + t.tile_rows]
         best_v = jnp.full((t.tile_rows, k), -jnp.inf, dtype=t.dtype)
         best_i = jnp.zeros((t.tile_rows, k), dtype=jnp.int32)
-        for j in range(t.n_tiles):
+        for j in range(self._n_live_tiles):
             j0 = j * t.tile_rows
             best_v, best_i = sp.stream_merge_topk(
                 ci, t.tile(j), di, d_all[j0 : j0 + t.tile_rows],
@@ -522,7 +561,8 @@ class JaxSparseBackend(PathSimBackend):
         vals, idxs = self._empty_result(k)
         empty_v = jnp.full((t.tile_rows, k), -jnp.inf, dtype=t.dtype)
         empty_i = jnp.zeros((t.tile_rows, k), dtype=jnp.int32)
-        best = {j: (empty_v, empty_i) for j in range(t.n_tiles)}
+        n_live = self._n_live_tiles
+        best = {j: (empty_v, empty_i) for j in range(n_live)}
 
         start = 0
         prev_key = None
@@ -551,14 +591,14 @@ class JaxSparseBackend(PathSimBackend):
                     rows_here = min(t.tile_rows, self.n - i0)
                     vals[i0 : i0 + rows_here] = unit["vals"]
                     idxs[i0 : i0 + rows_here] = unit["idxs"]
-                for pos, j in enumerate(range(after + 1, t.n_tiles)):
+                for pos, j in enumerate(range(after + 1, n_live)):
                     best[j] = (
                         jnp.asarray(part["vals"][pos], dtype=t.dtype),
                         jnp.asarray(part["idxs"][pos], dtype=jnp.int32),
                     )
                 start = after + 1
 
-        for i in range(start, t.n_tiles):
+        for i in range(start, n_live):
             # Preemption point (outer-tile boundary): every finished row
             # unit is already durable; a fresh partials snapshot makes
             # the restart resume exactly here instead of at the last
@@ -589,7 +629,7 @@ class JaxSparseBackend(PathSimBackend):
                     jnp.int32(i0), jnp.int32(i0), k=k, n_true=self.n,
                 ),
             )
-            for j in range(i + 1, t.n_tiles):
+            for j in range(i + 1, n_live):
                 j0 = j * t.tile_rows
                 cj = t.tile(j)
                 dj = d_all[j0 : j0 + t.tile_rows]
@@ -616,7 +656,7 @@ class JaxSparseBackend(PathSimBackend):
                     vals=vals[i0 : i0 + rows_here],
                     idxs=idxs[i0 : i0 + rows_here],
                 )
-                last = i == t.n_tiles - 1
+                last = i == n_live - 1
                 if i % self._PARTIALS_EVERY == self._PARTIALS_EVERY - 1 or last:
                     prev_key = self._save_sym_partials(
                         ckpt, best, after=i, prev_key=prev_key, k=k
@@ -631,7 +671,7 @@ class JaxSparseBackend(PathSimBackend):
         before the manifest references them). Idempotent: re-saving the
         same key overwrites identical contents."""
         t = self.tiled
-        rest = range(after + 1, t.n_tiles)
+        rest = range(after + 1, self._n_live_tiles)
         jax.block_until_ready([best[j][0] for j in rest])
         new_key = f"{self._PARTIALS_PREFIX}{after}"
         ckpt.save_unit(

@@ -11,7 +11,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..ops import chain
-from .base import PathSimBackend, register_backend
+from .base import DeltaUnsupported, PathSimBackend, register_backend
 
 
 @register_backend("numpy")
@@ -29,13 +29,16 @@ class NumpyBackend(PathSimBackend):
         self._m: np.ndarray | None = None
         self._rowsums: np.ndarray | None = None
 
+    # Internal caches stay at capacity shape (delta updates patch them
+    # in place); every return value is trimmed to the logical size.
+
     def commuting_matrix(self) -> np.ndarray:
         if self._m is None:
             if self._c is not None:
                 self._m = chain.commuting_matrix_from_half(self._c, xp=np)
             else:
                 self._m = chain.chain_product(self._blocks, xp=np)
-        return self._m
+        return self._m[: self.n_sources, : self.n_targets]
 
     def global_walks(self) -> np.ndarray:
         if self._rowsums is None:
@@ -43,18 +46,19 @@ class NumpyBackend(PathSimBackend):
                 self._rowsums = chain.rowsums_from_half(self._c, xp=np)
             else:
                 self._rowsums = chain.rowsums_general(self._blocks, xp=np)
-        return self._rowsums
+        return self._rowsums[: self.n_sources]
 
     def pairwise_row(self, source_index: int) -> np.ndarray:
+        n = self.n_targets
         if self._m is not None:
-            return self._m[source_index]
+            return self._m[source_index, :n]
         if self._c is not None:
-            return chain.pairwise_row_from_half(self._c, source_index, xp=np)
+            return chain.pairwise_row_from_half(self._c, source_index, xp=np)[:n]
         # general chain: fold source one-hot from the left
         v = self._blocks[0][source_index]
         for b in self._blocks[1:]:
             v = v @ b
-        return v
+        return v[:n]
 
     def pairwise_rows(self, rows) -> np.ndarray:
         """Batched M[rows, :] as ONE GEMM against the half factor (or a
@@ -62,11 +66,27 @@ class NumpyBackend(PathSimBackend):
         f64 path counts are exact integers below 2⁵³, so the GEMM's sum
         order cannot diverge from the per-row GEMV."""
         rows = np.asarray(rows, dtype=np.int64)
+        n = self.n_targets
         if self._m is not None:
-            return self._m[rows]
+            return self._m[rows][:, :n]
         if self._c is not None:
-            return self._c[rows] @ self._c.T
+            return (self._c[rows] @ self._c.T)[:, :n]
         v = self._blocks[0][rows]
         for b in self._blocks[1:]:
             v = v @ b
-        return v
+        return v[:, :n]
+
+    def _apply_delta_impl(self, plan) -> None:
+        """Patch the dense half factor with the signed ΔC scatter —
+        f64 integer adds are exact, so the patched C equals a rebuilt C
+        bit-for-bit — and drop the derived caches (M, rowsums), which
+        recompute lazily from the patched factor through the very same
+        code paths a fresh build uses."""
+        if self._c is None:
+            raise DeltaUnsupported(
+                "numpy backend patches only the symmetric half factor"
+            )
+        dc = plan.delta_c
+        np.add.at(self._c, (dc.rows, dc.cols), dc.weights.astype(self.dtype))
+        self._m = None
+        self._rowsums = None

@@ -75,6 +75,15 @@ def build_parser() -> argparse.ArgumentParser:
         "for graphs whose path counts exceed 2^24 (scores stay within "
         "the 1e-5 gate; only the guard is waived)",
     )
+    p.add_argument(
+        "--headroom",
+        type=float,
+        default=0.0,
+        help="index-capacity reserve (fraction per node type) so array "
+        "shapes — and compiled programs — survive node appends; results "
+        "are bit-identical either way (mainly for `serve` update flows; "
+        "batch runs rarely need it)",
+    )
     p.add_argument("--source", default=None, help="source node label (e.g. author name)")
     p.add_argument("--source-id", default=None, help="source node id (e.g. author_395340)")
     p.add_argument("--output", default=None, help="reference-grammar log file")
@@ -342,6 +351,7 @@ def _run(args) -> int:
         loader=args.loader,
         tile_rows=args.tile_rows,
         approx=args.approx,
+        headroom=args.headroom,
         echo=not args.quiet,
         max_retries=args.max_retries,
         degrade=not args.no_degrade,
@@ -441,6 +451,7 @@ def _run_multipath(args) -> int:
         "--checkpoint-dir": args.checkpoint_dir is not None,
         "--tile-rows": args.tile_rows is not None,
         "--approx": args.approx,
+        "--headroom": args.headroom != 0.0,
         # no backend chain to step down in this mode — refuse rather
         # than silently ignore
         "--no-degrade": args.no_degrade,

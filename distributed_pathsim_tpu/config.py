@@ -29,6 +29,10 @@ class RunConfig:
     loader: str = "auto"  # GEXF loader: auto | python | native
     tile_rows: int | None = None  # jax-sparse: rows per streaming tile
     approx: bool = False  # jax-sparse: waive the exact-count guard
+    # Index-space capacity reserve (data/delta.py): 0.25 pads every type
+    # by 25% so node appends up to the reserve never change array shapes
+    # (the recompile-free delta-serving contract). 0 = no reserve.
+    headroom: float = 0.0
     echo: bool = True
     # Resilience knobs (see resilience/): None = PATHSIM_MAX_RETRIES env
     # default (3 attempts total); degrade=False makes backend-init

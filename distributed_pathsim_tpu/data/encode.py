@@ -25,6 +25,13 @@ class TypeIndex:
     (and log) order. ``size_override`` supports huge synthetic graphs
     whose ids are implicit ranges (no per-node strings); such indices
     still report the correct size but cannot resolve string ids.
+
+    ``capacity`` (when set) reserves index slots beyond ``size`` — the
+    delta-ingestion headroom (data/delta.py): adjacency blocks are built
+    at capacity shape so node appends up to the reserve never change any
+    array shape (and therefore never invalidate a compiled program).
+    Slots in ``[size, capacity)`` carry no edges and are invisible to
+    every logical-size consumer.
     """
 
     node_type: str
@@ -32,13 +39,41 @@ class TypeIndex:
     labels: tuple[str, ...]
     index_of: dict[str, int]
     size_override: int | None = None
+    capacity: int | None = None
 
     @property
     def size(self) -> int:
+        """Logical node count (never the padded capacity)."""
         return self.size_override if self.size_override is not None else len(self.ids)
+
+    @property
+    def padded_size(self) -> int:
+        """Array-shape size: capacity when headroom is reserved, else
+        the logical size."""
+        return self.capacity if self.capacity is not None else self.size
+
+    @property
+    def headroom(self) -> int:
+        return self.padded_size - self.size
 
     def label_of_index(self, i: int) -> str:
         return self.labels[i]
+
+    def index_of_label(self, label: str) -> int | None:
+        """Label → first dense index, O(1) via a lazily built map.
+
+        Labels are not unique (author names collide); ``labels.index``
+        semantics — first occurrence wins — are preserved by the
+        setdefault construction. The map is built once per TypeIndex
+        (frozen dataclass: cached via ``object.__setattr__``) instead of
+        paying an O(N) list scan on every serving-path resolve."""
+        cache = self.__dict__.get("_label_index")
+        if cache is None:
+            cache = {}
+            for i, lab in enumerate(self.labels):
+                cache.setdefault(lab, i)
+            object.__setattr__(self, "_label_index", cache)
+        return cache.get(label)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -92,12 +127,9 @@ class EncodedHIN:
 
     def find_index_by_label(self, node_type: str, label: str) -> int | None:
         """Label→dense index within a type (the reference's name→id lookup,
-        ``DPathSim_APVPA.py:132-137``, composed with index encoding)."""
-        idx = self.indices[node_type]
-        try:
-            return idx.labels.index(label)
-        except ValueError:
-            return None
+        ``DPathSim_APVPA.py:132-137``, composed with index encoding).
+        O(1): this sits on the per-request serving path (resolve_source)."""
+        return self.indices[node_type].index_of_label(label)
 
     def resolve_source(
         self,

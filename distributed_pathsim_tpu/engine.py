@@ -139,21 +139,20 @@ def build_backend(
             use_native=USE_NATIVE_BY_LOADER[config.loader],
             policy=policy,
         )
+        if config.headroom:
+            # Reserve append capacity BEFORE any backend sees a shape:
+            # the delta-ingestion contract (data/delta.py) — results are
+            # bit-identical, shapes survive node growth.
+            from .data.delta import with_headroom
+
+            hin = with_headroom(hin, config.headroom)
     with timer.stage("metapath_compile"):
         metapath = resilience.resilient_call(
             "metapath_compile",
             lambda: compile_metapath(config.metapath, hin.schema),
             policy,
         )
-    options = {}
-    if config.n_devices is not None:
-        options["n_devices"] = config.n_devices
-    if config.dtype:
-        options["dtype"] = _resolve_dtype(config.backend, config.dtype)
-    if config.tile_rows is not None:
-        options["tile_rows"] = config.tile_rows
-    if config.approx:
-        options["exact_counts"] = False
+    options = backend_options(config)
     with timer.stage("backend_init"):
         backend = resilience.create_backend_resilient(
             config.backend,
@@ -164,6 +163,23 @@ def build_backend(
             **options,
         )
     return hin, metapath, backend
+
+
+def backend_options(config: RunConfig) -> dict:
+    """Backend constructor kwargs from a RunConfig — shared by the
+    bootstrap above and the serving layer's delta-fallback rebuild
+    (PathSimService's backend factory must replay the SAME knobs, or a
+    rebuild would silently change dtype/tiling mid-serve)."""
+    options: dict = {}
+    if config.n_devices is not None:
+        options["n_devices"] = config.n_devices
+    if config.dtype:
+        options["dtype"] = _resolve_dtype(config.backend, config.dtype)
+    if config.tile_rows is not None:
+        options["tile_rows"] = config.tile_rows
+    if config.approx:
+        options["exact_counts"] = False
+    return options
 
 
 def _resolve_dtype(backend: str, dtype: str):

@@ -11,7 +11,7 @@ See DESIGN.md §18. Public surface:
   (protocol.py); the ``dpathsim serve`` subcommand lives in cli.py.
 """
 
-from .cache import graph_fingerprint
+from .cache import chain_fingerprint, graph_fingerprint
 from .coalescer import LoadShedError, ServiceClosed
 from .service import PathSimService, ServeConfig, build_service
 
@@ -21,5 +21,6 @@ __all__ = [
     "build_service",
     "LoadShedError",
     "ServiceClosed",
+    "chain_fingerprint",
     "graph_fingerprint",
 ]

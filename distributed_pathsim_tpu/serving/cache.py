@@ -39,7 +39,17 @@ from ..data.encode import EncodedHIN
 def graph_fingerprint(hin: EncodedHIN) -> str:
     """Content hash of the encoded graph: every adjacency block's COO
     plus the per-type sizes. Two graphs with equal fingerprints produce
-    equal scores, so the fingerprint is a sound cache key component."""
+    equal scores, so the fingerprint is a sound cache key component.
+
+    Memoized per EncodedHIN (``object.__setattr__`` on the frozen
+    dataclass): re-hashing every COO block on each reload/build was an
+    O(nnz) tax the serving path paid repeatedly, and delta-derived HINs
+    carry a CHAINED fingerprint seeded by plan_delta
+    (:func:`chain_fingerprint`) — their blocks are never hashed at all.
+    """
+    cached = hin.__dict__.get("_fingerprint_cache")
+    if cached is not None:
+        return cached
     h = hashlib.sha256()
     for t in sorted(hin.schema.node_types):
         h.update(f"{t}:{hin.type_size(t)};".encode())
@@ -48,7 +58,22 @@ def graph_fingerprint(hin: EncodedHIN) -> str:
         h.update(f"{name}:{b.shape};".encode())
         h.update(np.ascontiguousarray(b.rows, dtype=np.int64).tobytes())
         h.update(np.ascontiguousarray(b.cols, dtype=np.int64).tobytes())
-    return h.hexdigest()[:16]
+    fp = h.hexdigest()[:16]
+    object.__setattr__(hin, "_fingerprint_cache", fp)
+    return fp
+
+
+def chain_fingerprint(base_fp: str, delta_digest: str) -> str:
+    """Fingerprint of base graph ⊕ delta: ``sha256(base ∥ delta)``.
+
+    Sound as cache identity because a delta batch is content-addressed
+    (DeltaBatch.digest hashes its arrays) and apply_delta is a pure
+    function of (graph, delta) — equal chains denote equal graphs. The
+    ``~`` separator keeps the 17-char chained form disjoint from the
+    16-hex-char base form."""
+    return (
+        "~" + hashlib.sha256(f"{base_fp}|{delta_digest}".encode()).hexdigest()[:16]
+    )
 
 
 class ResultCache:
@@ -87,6 +112,22 @@ class ResultCache:
     def clear(self) -> None:
         with self._lock:
             self._d.clear()
+
+    def purge_rows(self, rows) -> int:
+        """Drop every entry whose source row is in ``rows`` — the
+        row-granular delta invalidation. Keys are
+        ``(..., row, k)``; entries for other rows survive untouched.
+        Returns how many entries were dropped. O(entries), bounded by
+        the LRU capacity — far cheaper than the total flush it
+        replaces (which also evicted every still-valid answer)."""
+        rows = set(int(r) for r in rows)
+        if not rows:
+            return 0
+        with self._lock:
+            doomed = [key for key in self._d if int(key[-2]) in rows]
+            for key in doomed:
+                del self._d[key]
+            return len(doomed)
 
     def __len__(self) -> int:
         with self._lock:
@@ -144,6 +185,26 @@ class HotTileCache:
         with self._lock:
             self._tiles.clear()
             self._bytes = 0
+
+    def purge_rows(self, rows) -> int:
+        """Drop the cached score rows in ``rows`` (delta invalidation).
+        Tiles keep their surviving rows — eviction stays tile-granular,
+        invalidation is row-granular. Returns rows dropped."""
+        rows = set(int(r) for r in rows)
+        if not rows:
+            return 0
+        dropped = 0
+        with self._lock:
+            for key in list(self._tiles):
+                tile = self._tiles[key]
+                doomed = [r for r in tile if r in rows]
+                for r in doomed:
+                    self._bytes -= tile[r].nbytes
+                    del tile[r]
+                dropped += len(doomed)
+                if not tile:
+                    del self._tiles[key]
+        return dropped
 
     @property
     def bytes_used(self) -> int:

@@ -71,6 +71,17 @@ def build_serve_parser() -> argparse.ArgumentParser:
         "--batch-events", action="store_true",
         help="emit a JSONL event per dispatched batch",
     )
+    p.add_argument(
+        "--headroom", type=float, default=0.25,
+        help="index-capacity reserve for recompile-free 'update' ops "
+        "(fraction of each type's size; 0 disables — every node append "
+        "then forces a full rebuild)",
+    )
+    p.add_argument(
+        "--delta-threshold", type=float, default=0.05,
+        help="'update' batches changing more than this fraction of "
+        "edges rebuild instead of patching",
+    )
     return p
 
 
@@ -98,6 +109,7 @@ def serve_main(argv: list[str] | None = None) -> int:
         n_devices=args.n_devices,
         tile_rows=args.tile_rows,
         approx=args.approx,
+        headroom=args.headroom,
         echo=False,
     )
     serve_config = ServeConfig(
@@ -109,6 +121,7 @@ def serve_main(argv: list[str] | None = None) -> int:
         k_default=args.k,
         warm=not args.no_warm,
         batch_events=args.batch_events,
+        delta_threshold=args.delta_threshold,
     )
     logger = RunLogger(output_path=None, echo=False,
                        metrics_path=args.metrics)

@@ -16,6 +16,17 @@ Requests::
     {"id": 5, "op": "invalidate"}
     {"id": 6, "op": "ping"}
     {"id": 7, "op": "shutdown"}
+    {"id": 8, "op": "update",
+     "add_nodes": [{"type": "author", "id": "a_new", "label": "A. New"}],
+     "add_edges": [{"rel": "author_of", "src": "a_new", "dst": "paper_7"}],
+     "remove_edges": [{"rel": "author_of", "src_row": 4, "dst_row": 17}]}
+
+The ``update`` op is the delta-ingestion entry point (data/delta.py): a
+warm service absorbs the batch without a reload — O(Δ) patch, zero new
+XLA compiles in steady state, and only the affected rows' cache entries
+are invalidated. Its result reports which path ran (``mode``:
+``delta`` | ``rebuild``), how many score rows the change touched, and
+the new chained fingerprint.
 
 Responses mirror the id and carry ``ok``; successes add ``result`` and
 ``latency_ms``, failures add ``error``. Unknown ops / bad JSON are
@@ -60,6 +71,16 @@ def handle_request(service: PathSimService, req: dict) -> dict:
                     for i, lab, s in hits
                 ]
             }
+        elif op == "update":
+            from ..data.delta import delta_from_records
+
+            delta = delta_from_records(
+                service.hin,
+                add_nodes=req.get("add_nodes", ()),
+                add_edges=req.get("add_edges", ()),
+                remove_edges=req.get("remove_edges", ()),
+            )
+            result = service.update(delta)
         elif op == "scores":
             row = service.resolve(
                 source=req.get("source"),

@@ -54,6 +54,10 @@ class ServeConfig:
     warm: bool = True                 # pre-compile buckets at startup
     request_timeout_s: float = 60.0
     batch_events: bool = False        # per-batch JSONL events
+    # Delta ingestion: a batch changing more than this fraction of the
+    # graph's edges (or exhausting index headroom) rebuilds instead of
+    # patching — past it the O(Δ) machinery converges on rebuild cost.
+    delta_threshold: float = 0.05
 
 
 class PathSimService:
@@ -65,6 +69,7 @@ class PathSimService:
         backend: PathSimBackend,
         variant: str = "rowsum",
         config: ServeConfig | None = None,
+        backend_factory=None,
     ):
         self.config = config or ServeConfig()
         self.variant = variant
@@ -75,6 +80,17 @@ class PathSimService:
         )
         self._bucket_hist: dict[int, int] = {}
         self._wait_ms_sum = 0.0
+        # update()'s full-rebuild fallback needs a fresh backend for the
+        # delta-applied graph. The default rebuilds with the incumbent's
+        # class and pass-through options; build_service installs a
+        # factory that replays the full RunConfig knobs (dtype,
+        # tile_rows, …).
+        self._backend_factory = backend_factory or (
+            lambda hin: type(self.backend)(
+                hin, self.metapath, **self.backend.options
+            )
+        )
+        self._update_stats = {"deltas": 0, "rebuilds": 0, "purged_rows": 0}
         self._install_backend(backend, warm=self.config.warm)
         self.coalescer = Coalescer(
             issue=self._issue,
@@ -97,11 +113,16 @@ class PathSimService:
         self.node_type = backend.metapath.source_type
         self.index = self.hin.indices[self.node_type]
         self.n = self.index.size
-        self._fp = graph_fingerprint(self.hin)
-        # epoch key: every cache entry carries it, so entries from a
-        # previous graph can never be served after a reload even if
-        # explicit invalidation were lost
-        self._epoch = (self._fp, self.metapath.name, self.variant)
+        self._base_fp = graph_fingerprint(self.hin)
+        self._fp = self._base_fp
+        self._delta_seq = 0
+        # Per-row cache versions (sized to CAPACITY so node appends have
+        # slots): a delta update bumps only the rows it affects, so
+        # entries for every other row stay reachable — the row-granular
+        # alternative to flushing both tiers. The (base_fp, version) key
+        # pair can never resurrect a stale answer: versions only grow,
+        # and a rebuild/reload swaps base_fp itself.
+        self._row_ver = np.zeros(self.index.padded_size, dtype=np.int64)
         self._d = np.asarray(
             backend._denominators(self.variant), dtype=np.float64
         )
@@ -114,6 +135,18 @@ class PathSimService:
                 k=self.config.k_default,
                 variant=self.variant,
             )
+
+    def _epoch_for(self, row: int) -> tuple:
+        """Cache-identity prefix for one source row: install-time base
+        fingerprint + this row's delta version (+ the query identity
+        axes). Versioned per ROW, not per graph — that is what lets a
+        delta keep unaffected rows' entries live."""
+        return (
+            self._base_fp,
+            self.metapath.name,
+            self.variant,
+            int(self._row_ver[row]),
+        )
 
     # -- dispatch plumbing (runs on coalescer threads) ---------------------
 
@@ -139,14 +172,18 @@ class PathSimService:
         """Completion-thread half: fetch counts, normalize in f64, top-k
         per request (each gets the k-prefix it asked for), fill both
         cache tiers, resolve futures."""
-        counts = np.asarray(handle, dtype=np.float64)[: rows.shape[0]]
+        # column trim to the logical width: device handles from a
+        # capacity-padded backend carry zero-count pad columns
+        counts = np.asarray(handle, dtype=np.float64)[
+            : rows.shape[0], : self.n
+        ]
         scores = pathsim.score_rows(counts, self._d[rows], self._d, xp=np)
-        epoch = self._epoch
         masked = scores.copy()
         masked[np.arange(rows.shape[0]), rows] = -np.inf
         k_eff = min(k, max(self.n - 1, 1))
         vals, idxs = pathsim.topk_from_score_rows(masked, k_eff)
         for b, req in enumerate(batch):
+            epoch = self._epoch_for(int(rows[b]))
             # copy, not a view: a cached view would pin the whole [B, N]
             # batch array long past the byte budget's accounting
             self.tile_cache.put_row(epoch, int(rows[b]), scores[b].copy())
@@ -199,13 +236,14 @@ class PathSimService:
         # backend — admissions must not interleave with that swap (the
         # drain would never finish, and a request could resolve rows
         # against one graph and dispatch against another).
-        key = (*self._epoch, int(row), k)
+        epoch = self._epoch_for(row)
+        key = (*epoch, int(row), k)
         hit = self.result_cache.get(key)
         if hit is not None:
             fut: Future = Future()
             fut.set_result(hit)
             return fut
-        srow = self.tile_cache.get_row(self._epoch, int(row))
+        srow = self.tile_cache.get_row(epoch, int(row))
         if srow is not None:
             masked = srow.copy()
             masked[int(row)] = -np.inf
@@ -254,13 +292,13 @@ class PathSimService:
         # copies on the hit paths: callers mutate score rows (self-
         # masking is the natural first move), and handing out the
         # cache's own array would poison every later tier-2 hit
-        srow = self.tile_cache.get_row(self._epoch, row)
+        srow = self.tile_cache.get_row(self._epoch_for(row), row)
         if srow is not None:
             return srow.copy()
         # ride the normal dispatch path (fills the tile cache), then
         # read the row back out of it
         self.topk_index(row, self.config.k_default)
-        srow = self.tile_cache.get_row(self._epoch, row)
+        srow = self.tile_cache.get_row(self._epoch_for(row), row)
         if srow is not None:
             return srow.copy()
         # tile cache disabled (budget 0): compute directly
@@ -275,6 +313,91 @@ class PathSimService:
         self.result_cache.clear()
         self.tile_cache.clear()
         runtime_event("serve_invalidate", fingerprint=self._fp)
+
+    def update(self, delta) -> dict:
+        """Absorb a :class:`~..data.delta.DeltaBatch` into the WARM
+        service — the recompile-free alternative to :meth:`reload`.
+
+        Fast path (plan says patch): drain the pipeline, patch the
+        backend's half factor/denominators in place (O(Δ + affected
+        rows), zero new XLA compiles in steady state), bump the cache
+        version of exactly the affected score rows, and purge only
+        their entries — every unaffected row keeps its cached answers.
+        Fallback (headroom exhausted / Δ over threshold / backend or
+        chain without a patch path): build a fresh backend for the
+        delta-applied graph and swap it in, reload-style.
+
+        Returns an accounting dict (mode, affected rows, purges,
+        chained fingerprint) — also the JSONL ``update`` op's result."""
+        from ..backends.base import DeltaUnsupported
+        from ..data.delta import plan_delta
+
+        t0 = time.perf_counter()
+        with self._swap_lock:
+            self.coalescer.drain()
+            plan = plan_delta(
+                self.hin, delta, self.metapath,
+                max_delta_fraction=self.config.delta_threshold,
+            )
+            mode, reason = "delta", plan.reason
+            if not plan.fallback:
+                try:
+                    self.backend.apply_delta(plan)
+                except DeltaUnsupported as exc:
+                    mode, reason = "rebuild", str(exc)
+            else:
+                mode = "rebuild"
+            if mode == "rebuild":
+                self._install_backend(
+                    self._backend_factory(plan.hin_new),
+                    warm=self.config.warm,
+                )
+                self.invalidate()
+                self._update_stats["rebuilds"] += 1
+                affected_n, purged = self.n, -1  # everything went
+            else:
+                self.hin = plan.hin_new
+                self.index = self.hin.indices[self.node_type]
+                self.n = self.index.size
+                self._d = np.asarray(
+                    self.backend._denominators(self.variant),
+                    dtype=np.float64,
+                )
+                affected = plan.affected_rows
+                self._row_ver[affected] += 1
+                purged = self.result_cache.purge_rows(
+                    affected
+                ) + self.tile_cache.purge_rows(affected)
+                self._delta_seq += 1
+                self._fp = plan.fingerprint
+                affected_n = int(affected.shape[0])
+                self._update_stats["deltas"] += 1
+                self._update_stats["purged_rows"] += purged
+            ms = round((time.perf_counter() - t0) * 1e3, 3)
+            runtime_event(
+                "serve_update",
+                mode=mode,
+                reason=reason,
+                edge_changes=plan.n_edge_changes,
+                node_appends=plan.delta.n_node_appends,
+                affected_rows=affected_n,
+                purged_entries=purged,
+                delta_seq=self._delta_seq,
+                fingerprint=self._fp,
+                ms=ms,
+            )
+            return {
+                "mode": mode,
+                "reason": reason,
+                "edge_changes": plan.n_edge_changes,
+                "node_appends": plan.delta.n_node_appends,
+                "affected_rows": affected_n,
+                "purged_entries": purged,
+                "delta_seq": self._delta_seq,
+                "fingerprint": self._fp,
+                "n": self.n,
+                "ms": ms,
+            }
 
     def reload(self, backend: PathSimBackend) -> None:
         """Swap in a freshly built backend (graph reload): drain the
@@ -301,6 +424,12 @@ class PathSimService:
             "variant": self.variant,
             "backend": self.backend.name,
             "fingerprint": self._fp,
+            "delta": {
+                "seq": self._delta_seq,
+                "base_fingerprint": self._base_fp,
+                "headroom": self.index.headroom,
+                **self._update_stats,
+            },
             "result_cache": {
                 "hits": self.result_cache.hits,
                 "misses": self.result_cache.misses,
@@ -335,12 +464,19 @@ def build_service(
     """RunConfig → warm PathSimService (engine bootstrap + serving
     wrap): the one-call path the ``serve`` CLI and the load generator
     share."""
-    from ..engine import build_backend
+    from ..backends.base import create_backend
+    from ..engine import backend_options, build_backend
 
     t0 = time.perf_counter()
-    _, _, backend = build_backend(config, timer=timer)
+    _, metapath, backend = build_backend(config, timer=timer)
     service = PathSimService(
-        backend, variant=config.variant, config=serve_config
+        backend,
+        variant=config.variant,
+        config=serve_config,
+        # delta-fallback rebuilds replay the full RunConfig knobs
+        backend_factory=lambda hin: create_backend(
+            config.backend, hin, metapath, **backend_options(config)
+        ),
     )
     runtime_event(
         "serve_ready",

@@ -78,6 +78,50 @@ def warm_compile_cache(
     return times
 
 
+class CompileCounter:
+    """Counts XLA backend compiles via jax.monitoring — the
+    zero-new-compiles assertion hook for the delta-serving contract
+    (``bench_serving.py --regime update`` and ``make update-smoke``).
+
+    Counts ``/jax/core/compile/backend_compile_duration`` events: one
+    fires per actual XLA compilation, none on executable-cache hits —
+    exactly the thing a steady-state delta update must never trigger.
+    Context manager; ``count`` is cumulative while registered.
+    """
+
+    _EVENT_SUFFIX = "backend_compile_duration"
+
+    def __init__(self):
+        self.count = 0
+        self._registered = False
+
+    def _on_event(self, name: str, value, **kwargs) -> None:
+        if name.endswith(self._EVENT_SUFFIX):
+            self.count += 1
+
+    def __enter__(self) -> "CompileCounter":
+        from jax._src import monitoring
+
+        monitoring.register_event_duration_secs_listener(self._on_event)
+        self._registered = True
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if not self._registered:
+            return
+        try:
+            from jax._src import monitoring
+
+            monitoring._unregister_event_duration_listener_by_callback(
+                self._on_event
+            )
+        except Exception:
+            # Listener left behind on exotic jax versions: counting into
+            # a dead object is harmless; never fail the caller.
+            pass
+        self._registered = False
+
+
 def device_flags_value(n_devices: int, flags: str | None = None) -> str:
     """The XLA_FLAGS string with the host-device count forced to
     ``n_devices``, preserving any other flags present."""
