@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: test chaos serve-smoke update-smoke
+.PHONY: test chaos serve-smoke update-smoke obs-smoke lint-telemetry
 
 # Tier-1: the fast CPU suite (the driver's acceptance gate).
 test:
@@ -32,3 +32,21 @@ serve-smoke:
 update-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) bench_serving.py --regime update --smoke \
 		--out BENCH_SERVING_UPDATE_r07.json
+
+# Observability smoke: four arms (off / metrics / sampled tracing /
+# full tracing) interleaved on the same steady-state workload, with
+# hard gates on what is stable on shared hardware: zero additional
+# XLA compiles under every arm, connected
+# enqueue→dispatch→device→complete traces, head sampling genuinely
+# suppressing span creation, absolute added cost per fully-traced
+# request < 1 ms (per-arm µs envelopes are the full-size artifact's
+# claim, BENCH_OBS_r08.json). The same run is wired as a non-slow
+# pytest (tests/test_obs.py::test_bench_obs_smoke), so tier-1 covers it.
+obs-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) bench_serving.py --regime obs --smoke
+
+# Telemetry discipline: no wall-clock durations, no raw stderr prints
+# in library code, no event-sink bypasses. Also a non-slow pytest
+# (tests/test_obs.py::test_lint_telemetry), so tier-1 covers it.
+lint-telemetry:
+	$(PYTHON) scripts/lint_telemetry.py

@@ -401,6 +401,256 @@ def run_update_smoke(out_path: str | None = None) -> dict:
     return result
 
 
+def _trace_is_connected(spans) -> dict:
+    """Audit the tracer ring for the acceptance contract: EVERY
+    dispatched request trace reaches the device work — batch heads
+    directly (a connected enqueue → dispatch → device_execute →
+    complete chain inside the trace), non-head batch members through
+    the ``batch_span`` link their enqueue span carries (it must
+    resolve to a live ``serve.dispatch`` span). Shed requests never
+    dispatch, so they are exempt; anything else with an enqueue span
+    but no path to a dispatch is reported as unlinked."""
+    by_id = {s.span_id: s for s in spans}
+    by_trace: dict[int, list] = {}
+    for s in spans:
+        by_trace.setdefault(s.trace_id, []).append(s)
+    needed = {
+        "serve.enqueue", "serve.dispatch", "serve.device_execute",
+        "serve.complete",
+    }
+    connected = 0
+    linked = 0
+    unlinked = 0
+    broken_parents = 0
+    for tid, members in by_trace.items():
+        names = {s.name for s in members}
+        if "serve.request" not in names or "serve.enqueue" not in names:
+            continue  # cache hits / bootstrap stages: no dispatch due
+        ok = True
+        for s in members:
+            if s.parent_id is None:
+                continue
+            parent = by_id.get(s.parent_id)
+            if parent is None or parent.trace_id != tid:
+                ok = False
+                broken_parents += 1
+        if needed <= names:  # batch head: device chain in-trace
+            if ok:
+                connected += 1
+            continue
+        enq = next(s for s in members if s.name == "serve.enqueue")
+        if enq.args.get("outcome") == "shed":
+            continue
+        ref = enq.args.get("batch_span")
+        dispatch = (
+            by_id.get(int(ref.split(":")[1])) if ref else None
+        )
+        if ok and dispatch is not None and dispatch.name == "serve.dispatch":
+            linked += 1
+        else:
+            unlinked += 1
+    return {
+        "dispatched_request_traces": connected + linked,
+        "head_traces": connected,
+        "linked_member_traces": linked,
+        "unlinked_request_traces": unlinked,
+        "broken_parent_links": broken_parents,
+        "total_spans": len(spans),
+    }
+
+
+def run_obs_bench(
+    n_authors: int = 2048,
+    n_papers: int = 4096,
+    n_venues: int = 48,
+    clients: int = 32,
+    queries_per_client: int = 64,
+    max_batch: int = 32,
+    max_wait_ms: float = 2.0,
+    reps: int = 3,
+    k: int = 10,
+    backend: str = "jax",
+    seed: int = 0,
+) -> dict:
+    """The observability overhead contract, measured head to head.
+
+    Same graph/load shape as the steady-state (mixed 50% hot / 50%
+    uniform) regime of BENCH_SERVING_r06; each rep runs the identical
+    workload on a fresh service under FOUR arms, interleaved so machine
+    drift hits every arm equally:
+
+    - ``off``      — metrics registry off, tracing off (the baseline);
+    - ``metrics``  — metrics on, tracing off (the serve default);
+    - ``sampled``  — metrics on, tracing on at 1-in-16 head sampling
+      (the production tracing posture, DESIGN.md §20);
+    - ``traced``   — metrics on, EVERY request traced (the debugging
+      posture, what ``--trace-out`` alone gives you).
+
+    Reports median QPS and per-request added cost vs ``off`` for each
+    arm, steady-state XLA compile counts (all must be zero — obs must
+    never perturb the shape-bucket contract), and a connectivity audit
+    of each tracing arm (one dispatched sampled-in request = one
+    connected enqueue→dispatch→device→complete chain)."""
+    from distributed_pathsim_tpu import obs
+    from distributed_pathsim_tpu.data.synthetic import synthetic_hin
+    from distributed_pathsim_tpu.utils.xla_flags import CompileCounter
+
+    hin = synthetic_hin(n_authors, n_papers, n_venues, seed=seed)
+    rng = np.random.default_rng(seed)
+    n = hin.type_size("author")
+    hot_set = rng.choice(n, size=max(8, n // 64), replace=False)
+    hot = rng.choice(hot_set, size=(clients, queries_per_client))
+    mixed = np.where(
+        rng.random((clients, queries_per_client)) < 0.5,
+        hot,
+        rng.integers(0, n, size=(clients, queries_per_client)),
+    ).tolist()
+
+    ARMS = {
+        "off": dict(metrics=False, tracing=False, trace_sample=1),
+        "metrics": dict(metrics=True, tracing=False, trace_sample=1),
+        "sampled": dict(metrics=True, tracing=True, trace_sample=16),
+        "traced": dict(metrics=True, tracing=True, trace_sample=1),
+    }
+
+    def one_arm(cfg: dict) -> dict:
+        obs.configure(**cfg)
+        if cfg["tracing"]:
+            obs.get_tracer().clear()
+        svc = _build_service(hin, backend, max_batch=max_batch,
+                             max_wait_ms=max_wait_ms, caches=True, k=k)
+        try:
+            for r in hot_set:  # warm: hot set cached, buckets compiled
+                svc.topk_index(int(r), k=k)
+            with CompileCounter() as cc:
+                res = _run_clients(svc, mixed, k)
+            res["steady_state_compiles"] = cc.count
+        finally:
+            svc.close()
+        if cfg["tracing"]:
+            res["trace_audit"] = _trace_is_connected(
+                obs.get_tracer().spans()
+            )
+        return res
+
+    runs: dict[str, list[dict]] = {name: [] for name in ARMS}
+    try:
+        for _ in range(reps):
+            for name, cfg in ARMS.items():
+                runs[name].append(one_arm(cfg))
+    finally:
+        # restore process defaults (metrics on, tracing off) — later
+        # code in this process must not inherit a bench arm's switches
+        obs.configure(metrics=True, tracing=False, trace_sample=1)
+        obs.get_tracer().clear()
+
+    med = lambda xs: sorted(xs)[len(xs) // 2]
+    arms_out: dict[str, dict] = {}
+    qps_off = med([a["qps"] for a in runs["off"]])
+    # Best-window estimator alongside the median: on a shared box,
+    # background load only ever SLOWS a run down (noise is additive),
+    # so each arm's fastest rep is its least-contended window and the
+    # best-vs-best delta is the closest this box gets to a dedicated-
+    # machine measurement. The medians stay recorded; when the two
+    # disagree, drift was larger than the effect being measured.
+    best_off = max(a["qps"] for a in runs["off"])
+    for name in ARMS:
+        qps = med([a["qps"] for a in runs[name]])
+        best = max(a["qps"] for a in runs[name])
+        arm = {"qps_median": qps, "qps_best": best, "runs": runs[name]}
+        if name != "off":
+            arm["qps_regression"] = round(1.0 - qps / qps_off, 4)
+            arm["added_us_per_request"] = round(
+                (1.0 / qps - 1.0 / qps_off) * 1e6, 2
+            )
+            arm["qps_regression_best"] = round(1.0 - best / best_off, 4)
+            arm["added_us_per_request_best"] = round(
+                (1.0 / best - 1.0 / best_off) * 1e6, 2
+            )
+        if ARMS[name]["tracing"]:
+            # the final rep's audit is the recorded one (each arm run
+            # re-audits its own ring; any rep failing connectivity
+            # would already show broken links there)
+            arm["trace_audit"] = runs[name][-1]["trace_audit"]
+        arms_out[name] = arm
+    return {
+        "graph": {"authors": n, "papers": n_papers, "venues": n_venues,
+                  "seed": seed},
+        "load": {"clients": clients,
+                 "queries_per_client": queries_per_client,
+                 "regime": "mixed (steady state)", "k": k,
+                 "max_batch": max_batch, "max_wait_ms": max_wait_ms,
+                 "reps": reps},
+        "backend": backend,
+        "arms": arms_out,
+        "steady_state_compiles": {
+            name: sum(a["steady_state_compiles"] for a in runs[name])
+            for name in ARMS
+        },
+        "estimator_note": (
+            "multi-tenant box: baseline drifts up to 3x between reps, "
+            "so medians bound drift, qps_best/added_us_per_request_best "
+            "(fastest window per arm) is the dedicated-machine estimate; "
+            "compile counts and trace audits are deterministic"
+        ),
+    }
+
+
+def run_obs_smoke(out_path: str | None = None) -> dict:
+    """The tier-1 obs gate: a small fixed run with four hard checks —
+    (1) no obs arm causes a single additional steady-state XLA
+    compile, (2) the full-tracing arm's traces are connected
+    enqueue→dispatch→device→complete chains with zero broken parent
+    links, (3) head sampling genuinely suppresses span creation (the
+    sampled arm's ring carries a fraction of the traced arm's spans,
+    and its sampled-in traces are still connected), (4) the ABSOLUTE
+    cost full obs adds per request stays under 1 ms. The smoke graph's
+    per-query device work is microseconds, so a relative-QPS bound
+    here would measure scheduler noise, not obs (observed 4×
+    run-to-run QPS swings on a loaded CI box); the absolute bound is
+    stable there and still catches every pathology this gate exists
+    for (per-observation allocation, lock collapse, sample retention).
+    The relative steady-state numbers per arm are the full-size
+    artifact's claim (BENCH_OBS_r08.json)."""
+    result = run_obs_bench(
+        n_authors=384, n_papers=640, n_venues=12,
+        clients=8, queries_per_client=48,
+        max_batch=8, max_wait_ms=1.0, reps=3, k=5,
+    )
+    arms = result["arms"]
+    traced_audit = arms["traced"]["trace_audit"]
+    sampled_audit = arms["sampled"]["trace_audit"]
+    checks = {
+        "zero_additional_compiles": all(
+            v == 0 for v in result["steady_state_compiles"].values()
+        ),
+        "traces_connected": (
+            traced_audit["dispatched_request_traces"] > 0
+            and traced_audit["unlinked_request_traces"] == 0
+            and traced_audit["broken_parent_links"] == 0
+        ),
+        "sampling_suppresses_spans": (
+            sampled_audit["total_spans"]
+            < traced_audit["total_spans"] / 4
+            and sampled_audit["dispatched_request_traces"] > 0
+            and sampled_audit["unlinked_request_traces"] == 0
+            and sampled_audit["broken_parent_links"] == 0
+        ),
+        # best-window estimate: drift on a shared box only inflates a
+        # rep, so the fastest off-vs-traced pair is the stable gate
+        "overhead_under_1ms_per_request": (
+            arms["traced"]["added_us_per_request_best"] < 1000.0
+        ),
+    }
+    result["smoke_checks"] = checks
+    if out_path:
+        with open(out_path, "w", encoding="utf-8") as f:
+            json.dump(result, f, indent=2)
+    if not all(checks.values()):
+        raise AssertionError(f"obs smoke failed: {checks}")
+    return result
+
+
 def run_smoke(out_path: str | None = None) -> dict:
     """Small fixed-seed run with the two hard gates tier-1 enforces."""
     result = run_bench(
@@ -429,9 +679,11 @@ def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--smoke", action="store_true",
                    help="small fixed run with hard pass/fail gates")
-    p.add_argument("--regime", default="load", choices=("load", "update"),
+    p.add_argument("--regime", default="load",
+                   choices=("load", "update", "obs"),
                    help="'load': the closed-loop QPS regimes; 'update': "
-                   "delta-ingestion vs reload latency")
+                   "delta-ingestion vs reload latency; 'obs': "
+                   "observability overhead (obs on vs off, steady state)")
     p.add_argument("--edge-frac", type=float, default=0.01,
                    help="update regime: fraction of edges per Δ batch")
     p.add_argument("--reps", type=int, default=5,
@@ -451,7 +703,22 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--out", default=None, help="write the JSON here")
     args = p.parse_args(argv)
 
-    if args.regime == "update":
+    if args.regime == "obs":
+        if args.smoke:
+            result = run_obs_smoke(args.out)
+        else:
+            result = run_obs_bench(
+                n_authors=args.authors, n_papers=args.papers,
+                n_venues=args.venues, clients=args.clients,
+                queries_per_client=args.queries_per_client,
+                max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
+                reps=args.reps, k=args.k, backend=args.backend,
+                seed=args.seed,
+            )
+            if args.out:
+                with open(args.out, "w", encoding="utf-8") as f:
+                    json.dump(result, f, indent=2)
+    elif args.regime == "update":
         if args.smoke:
             result = run_update_smoke(args.out)
         else:

@@ -17,6 +17,7 @@ through :func:`get_backend` / :func:`create_backend`.
 from __future__ import annotations
 
 import abc
+import time
 from typing import Any, Callable
 
 import numpy as np
@@ -217,4 +218,22 @@ def available_backends() -> list[str]:
 def create_backend(
     name: str, hin: EncodedHIN, metapath: MetaPath, **options: Any
 ) -> PathSimBackend:
-    return get_backend(name)(hin, metapath, **options)
+    """Construct a backend, visible to obs: init count + duration land
+    in the registry (a serving process rebuilding backends at delta-
+    fallback rate shows up as a moving ``backend_inits`` line), and the
+    init runs inside a ``backend.init`` span so bootstrap traces show
+    where the half-chain fold / device transfer time went."""
+    from ..obs.metrics import get_registry
+    from ..obs.trace import get_tracer
+
+    t0 = time.perf_counter()
+    with get_tracer().span("backend.init", backend=name):
+        backend = get_backend(name)(hin, metapath, **options)
+    reg = get_registry()
+    reg.counter(
+        "dpathsim_backend_inits_total", "backend constructions by name"
+    ).inc(backend=name)
+    reg.histogram(
+        "dpathsim_backend_init_seconds", "backend construction duration"
+    ).observe(time.perf_counter() - t0, backend=name)
+    return backend

@@ -99,6 +99,20 @@ def build_parser() -> argparse.ArgumentParser:
         help="write a jax.profiler device trace (TensorBoard/Perfetto) here",
     )
     p.add_argument(
+        "--trace-out",
+        default=None,
+        help="enable host-side span tracing and write Chrome/Perfetto "
+        "trace-event JSON here at exit (bootstrap + run stage tree; "
+        "combine with --profile-dir for the device timeline)",
+    )
+    p.add_argument(
+        "--metrics-file",
+        default=None,
+        help="write one Prometheus textfile snapshot of the obs "
+        "registry here at exit (batch analog of serve's periodic "
+        "--metrics-file)",
+    )
+    p.add_argument(
         "--ranking-out",
         default=None,
         help="with --top-k and no --source: write every node's top-k "
@@ -357,8 +371,12 @@ def _run(args) -> int:
         degrade=not args.no_degrade,
     )
 
+    from . import obs
     from .utils.logging import set_event_sink
     from .utils.profiling import StageTimer
+
+    if args.trace_out:
+        obs.configure(tracing=True)
 
     # One logger + timer for the whole run: bootstrap stage timings
     # (load/encode, metapath compile, backend init) and compute stages
@@ -375,6 +393,10 @@ def _run(args) -> int:
     finally:
         set_event_sink(None)
         logger.close()
+        if args.trace_out:
+            print(obs.dump_trace(args.trace_out), file=sys.stderr)
+        if args.metrics_file:
+            obs.write_textfile(args.metrics_file)
 
 
 def _run_modes(args, config, logger: RunLogger, timer) -> int:
@@ -447,6 +469,8 @@ def _run_multipath(args) -> int:
         "--dtype": args.dtype != "float32",
         "--output": args.output is not None,
         "--metrics": args.metrics is not None,
+        "--trace-out": args.trace_out is not None,
+        "--metrics-file": args.metrics_file is not None,
         "--ranking-out": args.ranking_out is not None,
         "--checkpoint-dir": args.checkpoint_dir is not None,
         "--tile-rows": args.tile_rows is not None,

@@ -17,6 +17,7 @@ import numpy as np
 
 from . import resilience
 from .backends.base import PathSimBackend
+from .obs.trace import get_tracer
 from .utils.logging import RunLogger
 
 
@@ -57,6 +58,19 @@ class PathSimDriver:
     ) -> SingleSourceResult:
         """The reference's ``run()``: one source vs all other nodes of the
         endpoint type, with per-stage reference-grammar logging."""
+        # Root span for the whole run: the StageTimer stages inside
+        # nest under it, so a --trace-out dump shows one tree per query.
+        with get_tracer().span(
+            "driver.run_single_source", source=str(source)
+        ):
+            return self._run_single_source(source, by_label, logger)
+
+    def _run_single_source(
+        self,
+        source: str,
+        by_label: bool,
+        logger: RunLogger | None,
+    ) -> SingleSourceResult:
         logger = logger or RunLogger(output_path=None, echo=False)
         from .utils.profiling import StageTimer
 
@@ -138,10 +152,11 @@ class PathSimDriver:
     def run_all_pairs(self) -> np.ndarray:
         """All-pairs score matrix — the capability the reference
         extrapolates to ~24 h of joins (SURVEY.md §6)."""
-        return resilience.resilient_call(
-            "device_execute",
-            lambda: self.backend.all_pairs_scores(variant=self.variant),
-        )
+        with get_tracer().span("driver.run_all_pairs", n=self.index.size):
+            return resilience.resilient_call(
+                "device_execute",
+                lambda: self.backend.all_pairs_scores(variant=self.variant),
+            )
 
     def rank_all(self, k: int = 10, checkpoint_dir: str | None = None):
         """Per-source top-k ranking for EVERY node: (values [N, k] f64,
@@ -154,6 +169,10 @@ class PathSimDriver:
         never materializes N×N), fused on-device top-k (jax dense,
         pallas on TPU), dense score matrix + argsort (any backend).
         """
+        with get_tracer().span("driver.rank_all", k=k):
+            return self._rank_all(k, checkpoint_dir)
+
+    def _rank_all(self, k: int, checkpoint_dir: str | None):
         b = self.backend
         if hasattr(b, "topk_scores"):
             vals, idxs = b.topk_scores(

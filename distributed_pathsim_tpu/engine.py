@@ -82,6 +82,7 @@ def load_dataset(
         except (OSError, resilience.TransientError) as exc:
             if use_native is True:
                 raise ValueError(f"native GEXF loader failed: {exc}") from exc
+            resilience.degrade.record_degrade("loader")
             runtime_event(
                 "degrade",
                 component="loader",
@@ -127,6 +128,12 @@ def build_backend(
         from .utils.profiling import StageTimer
 
         timer = StageTimer()
+    # Bootstrap is where the first XLA programs compile — install the
+    # process-wide compile counter hook before any backend exists so
+    # the obs registry sees every compilation from the very first.
+    from .utils.xla_flags import install_compile_metrics
+
+    install_compile_metrics()
     if config.loader not in USE_NATIVE_BY_LOADER:
         raise ValueError(
             f"unknown loader {config.loader!r}; "

@@ -1,0 +1,84 @@
+"""Observability: hierarchical tracing spans, metrics, exporters.
+
+See DESIGN.md §20. Public surface:
+
+- :func:`get_tracer` / :class:`Tracer` / :class:`SpanContext` —
+  hierarchical spans with cross-thread context propagation, exportable
+  as Chrome/Perfetto trace-event JSON (trace.py);
+- :func:`get_registry` / :class:`MetricsRegistry` — process-wide
+  counters, gauges, and bounded-memory streaming histograms
+  (metrics.py);
+- :func:`render_prometheus` / :func:`write_textfile` /
+  :class:`PrometheusTextfileExporter` / :func:`write_chrome_trace` —
+  the on-disk/wire formats (export.py);
+- :func:`configure` — the one switch the CLIs and benches flip.
+
+Layering: this package imports nothing from the rest of
+``distributed_pathsim_tpu`` — everything else (serving, resilience,
+engine, driver, backends, utils) imports obs, never the reverse.
+"""
+
+from __future__ import annotations
+
+from .export import (
+    PrometheusTextfileExporter,
+    render_prometheus,
+    write_chrome_trace,
+    write_textfile,
+)
+from .metrics import (
+    MetricsRegistry,
+    geometric_bounds,
+    get_registry,
+    set_registry,
+)
+from .trace import Span, SpanContext, Tracer, get_tracer
+
+__all__ = [
+    "MetricsRegistry",
+    "PrometheusTextfileExporter",
+    "Span",
+    "SpanContext",
+    "Tracer",
+    "configure",
+    "dump_trace",
+    "geometric_bounds",
+    "get_registry",
+    "get_tracer",
+    "render_prometheus",
+    "set_registry",
+    "write_chrome_trace",
+    "write_textfile",
+]
+
+
+def configure(
+    metrics: bool | None = None,
+    tracing: bool | None = None,
+    max_spans: int | None = None,
+    device_annotations: bool | None = None,
+    trace_sample: int | None = None,
+) -> None:
+    """Flip the process-wide observability switches. ``None`` leaves a
+    switch untouched. Metrics default ON (aggregation is cheap and the
+    ``metrics``/``stats`` ops should always have answers); tracing
+    defaults OFF (span objects per request are only worth it when
+    someone will read the trace). ``trace_sample=n`` traces every nth
+    request head (1 = all; sustained production traffic wants a larger
+    n — span bookkeeping is serialized Python, see DESIGN.md §20)."""
+    if metrics is not None:
+        get_registry().enabled = metrics
+    get_tracer().configure(
+        enabled=tracing,
+        max_spans=max_spans,
+        device_annotations=device_annotations,
+        sample_every=trace_sample,
+    )
+
+
+def dump_trace(path: str) -> str:
+    """Write the span ring as Perfetto-loadable JSON and return the
+    one-line human summary both CLIs print at exit (the CLI prints it —
+    library code never writes raw stderr, lint_telemetry R2)."""
+    n = write_chrome_trace(path)
+    return f"trace: {n} spans -> {path} (load in https://ui.perfetto.dev)"

@@ -23,6 +23,19 @@ from ..utils.logging import runtime_event
 from . import inject
 from .policy import RetryPolicy, policy_from_env
 
+def record_degrade(component: str) -> None:
+    """The one registration site for ``dpathsim_degrades_total``: every
+    degradation seam (backend chain here, loader fallback in engine.py,
+    whatever comes next) counts through this, so the family's help text
+    and label shape can never drift between call sites."""
+    from ..obs.metrics import get_registry
+
+    get_registry().counter(
+        "dpathsim_degrades_total",
+        "degradation-chain step-downs by component",
+    ).inc(component=component)
+
+
 # name → next step down. Every chain ends at the numpy f64 oracle, which
 # has no device, no jit, and no native code to fail.
 BACKEND_DEGRADATION: dict[str, str] = {
@@ -84,6 +97,7 @@ def create_backend_resilient(
             last_exc = exc
             if candidate == chain[-1]:
                 raise
+            record_degrade("backend")
             runtime_event(
                 "degrade",
                 component="backend",

@@ -148,6 +148,12 @@ class FaultInjector:
             "hit": self.hits.get(rule.seam, 0),
         }
         self.events.append(ev)
+        from ..obs.metrics import get_registry
+
+        get_registry().counter(
+            "dpathsim_faults_injected_total",
+            "chaos-harness faults fired, by seam and kind",
+        ).inc(seam=rule.seam, kind=rule.kind)
         runtime_event("fault_injected", **ev)
 
     def fire(self, seam: str) -> None:

@@ -24,6 +24,7 @@ import random
 import time
 from typing import Callable, TypeVar
 
+from ..obs.metrics import get_registry
 from ..utils.logging import runtime_event
 
 T = TypeVar("T")
@@ -115,6 +116,9 @@ class RetryPolicy:
                         error=repr(exc),
                     )
                     raise
+                get_registry().counter(
+                    "dpathsim_retries_total", "retries by failure seam"
+                ).inc(seam=seam or "unknown")
                 runtime_event(
                     "retry",
                     seam=seam,

@@ -34,6 +34,27 @@ from collections import OrderedDict
 import numpy as np
 
 from ..data.encode import EncodedHIN
+from ..obs.metrics import get_registry
+
+
+def _tier_counters(tier: str):
+    """Bound obs counter cells for one cache tier — bound ONCE at cache
+    construction so the per-hit cost is a single cell increment, not a
+    registry lookup. Per-instance ``hits``/``misses`` attributes stay
+    authoritative for ``stats()``; the registry cells are the
+    process-wide aggregate Prometheus and the ``metrics`` op read."""
+    reg = get_registry()
+    return (
+        reg.counter(
+            "dpathsim_serve_cache_hits_total", "cache hits by tier"
+        ).labels(tier=tier),
+        reg.counter(
+            "dpathsim_serve_cache_misses_total", "cache misses by tier"
+        ).labels(tier=tier),
+        reg.counter(
+            "dpathsim_serve_cache_evictions_total", "cache evictions by tier"
+        ).labels(tier=tier),
+    )
 
 
 def graph_fingerprint(hin: EncodedHIN) -> str:
@@ -88,15 +109,18 @@ class ResultCache:
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        self._m_hits, self._m_misses, self._m_evict = _tier_counters("result")
 
     def get(self, key: tuple):
         with self._lock:
             hit = self._d.get(key)
             if hit is None:
                 self.misses += 1
+                self._m_misses.inc()
                 return None
             self._d.move_to_end(key)
             self.hits += 1
+            self._m_hits.inc()
             return hit
 
     def put(self, key: tuple, vals: np.ndarray, idxs: np.ndarray) -> None:
@@ -108,6 +132,7 @@ class ResultCache:
             while len(self._d) > self.capacity:
                 self._d.popitem(last=False)
                 self.evictions += 1
+                self._m_evict.inc()
 
     def clear(self) -> None:
         with self._lock:
@@ -149,6 +174,7 @@ class HotTileCache:
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        self._m_hits, self._m_misses, self._m_evict = _tier_counters("tile")
 
     def _tile_key(self, epoch: tuple, row: int) -> tuple:
         return (*epoch, row // self.tile_rows)
@@ -159,9 +185,11 @@ class HotTileCache:
             hit = None if tile is None else tile.get(row)
             if hit is None:
                 self.misses += 1
+                self._m_misses.inc()
                 return None
             self._tiles.move_to_end(self._tile_key(epoch, row))
             self.hits += 1
+            self._m_hits.inc()
             return hit
 
     def put_row(self, epoch: tuple, row: int, scores: np.ndarray) -> None:
@@ -180,6 +208,7 @@ class HotTileCache:
                 _, dropped = self._tiles.popitem(last=False)
                 self._bytes -= sum(v.nbytes for v in dropped.values())
                 self.evictions += 1
+                self._m_evict.inc()
 
     def clear(self) -> None:
         with self._lock:

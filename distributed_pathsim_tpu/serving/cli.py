@@ -82,6 +82,32 @@ def build_serve_parser() -> argparse.ArgumentParser:
         help="'update' batches changing more than this fraction of "
         "edges rebuild instead of patching",
     )
+    p.add_argument(
+        "--metrics-file", default=None,
+        help="Prometheus textfile: counters/gauges/latency histograms "
+        "re-written atomically every --metrics-interval (node-exporter "
+        "textfile-collector format)",
+    )
+    p.add_argument(
+        "--metrics-interval", type=float, default=5.0,
+        help="seconds between --metrics-file snapshots",
+    )
+    p.add_argument(
+        "--trace-out", default=None,
+        help="enable request tracing and write the span ring as "
+        "Chrome/Perfetto trace-event JSON here on shutdown",
+    )
+    p.add_argument(
+        "--trace-sample", type=int, default=1,
+        help="trace every Nth request (head sampling; 1 = every "
+        "request, the debugging default — sustained production "
+        "traffic wants 16+ to keep span bookkeeping off the hot path)",
+    )
+    p.add_argument(
+        "--no-metrics", action="store_true",
+        help="disable the in-process metrics registry entirely "
+        "(stats/metrics ops then report zeros)",
+    )
     return p
 
 
@@ -123,6 +149,20 @@ def serve_main(argv: list[str] | None = None) -> int:
         batch_events=args.batch_events,
         delta_threshold=args.delta_threshold,
     )
+    from .. import obs
+
+    obs.configure(
+        metrics=not args.no_metrics,
+        tracing=True if args.trace_out else None,
+        trace_sample=args.trace_sample,
+    )
+    exporter = (
+        obs.PrometheusTextfileExporter(
+            args.metrics_file, interval_s=args.metrics_interval
+        )
+        if args.metrics_file
+        else None
+    )
     logger = RunLogger(output_path=None, echo=False,
                        metrics_path=args.metrics)
     set_event_sink(logger)
@@ -131,6 +171,8 @@ def serve_main(argv: list[str] | None = None) -> int:
         service = build_service(config, serve_config)
         if args.platform == "tpu":
             _require_tpu()
+        if exporter is not None:
+            exporter.start()
         print(
             f"serving {service.metapath.name} over {service.n} "
             f"{service.node_type}s (backend={service.backend.name}); "
@@ -141,5 +183,9 @@ def serve_main(argv: list[str] | None = None) -> int:
     finally:
         if service is not None:
             service.close()
+        if exporter is not None:
+            exporter.stop()  # final write: shutdown state preserved
+        if args.trace_out:
+            print(obs.dump_trace(args.trace_out), file=sys.stderr)
         set_event_sink(None)
         logger.close()

@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import itertools
 import queue
 import threading
 import time
@@ -40,8 +41,14 @@ from typing import Any, Callable, Sequence
 
 import numpy as np
 
+from ..obs.metrics import get_registry
+from ..obs.trace import get_tracer
 from ..utils.logging import runtime_event
 from . import buckets as bk
+
+# process-wide coalescer sequence: the `instance` label that keeps one
+# service's queue-depth gauge / shed counter from merging with another's
+_INSTANCE_IDS = itertools.count()
 
 
 class LoadShedError(RuntimeError):
@@ -55,12 +62,26 @@ class ServiceClosed(RuntimeError):
 @dataclasses.dataclass
 class Request:
     """One admitted query. ``k`` is the requested top-k; the batch is
-    dispatched at the batch's max k and each request gets its prefix."""
+    dispatched at the batch's max k and each request gets its prefix.
+
+    ``span`` is the request's ROOT tracing span (opened by whoever
+    admitted the query, finished by whoever resolves the future — the
+    completion thread on the happy path); ``enq_span`` covers the time
+    the request sat in the queue, opened at submit and closed when the
+    dispatcher picks it up. Both are None when tracing is off.
+    ``t_submit`` is the admission timestamp from the SUBMITTER (taken
+    before the cache lookups under the swap lock) — the origin the
+    submit-to-resolve latency histogram measures from, shared with the
+    cache-hit outcomes so the per-outcome distributions are
+    origin-comparable; 0.0 when the caller didn't stamp one."""
 
     row: int
     k: int
     future: Future
     t_enqueue: float
+    span: Any = None
+    enq_span: Any = None
+    t_submit: float = 0.0
 
 
 @dataclasses.dataclass
@@ -104,6 +125,33 @@ class Coalescer:
                 f"max_batch={self.max_batch}"
             )
         self._on_batch = on_batch
+        # obs handles, bound once: per-submit cost is one gauge set;
+        # per-batch cost is two histogram observes + a labels() lookup.
+        # queue depth and sheds are labeled per coalescer instance —
+        # two services in one process must not last-write-wins each
+        # other's gauge (a second service's empty queue would mask the
+        # first one's backlog) or pool their shed attribution.
+        instance = str(next(_INSTANCE_IDS))
+        reg = get_registry()
+        self._m_queue_depth = reg.gauge(
+            "dpathsim_serve_queue_depth", "admitted requests waiting"
+        ).labels(instance=instance)
+        self._m_shed = reg.counter(
+            "dpathsim_serve_shed_total", "requests refused at the bound"
+        ).labels(instance=instance)
+        # fixed pow-2 ladder, NOT this coalescer's bucket tuple: the
+        # family is process-wide and its geometry belongs to the first
+        # registrant — two services with different max_batch must not
+        # fight over it (the registry raises on conflicting bounds)
+        self._m_occupancy = reg.histogram(
+            "dpathsim_serve_batch_occupancy",
+            "requests per dispatched batch, by shape bucket",
+            bounds=tuple(float(1 << i) for i in range(11)),
+        )
+        self._m_wait = reg.histogram(
+            "dpathsim_serve_batch_wait_seconds",
+            "first-enqueue to dispatch wait per batch",
+        ).labels()
         self._lock = threading.Lock()
         self._not_empty = threading.Condition(self._lock)
         self._queue: collections.deque[Request] = collections.deque()
@@ -132,17 +180,41 @@ class Coalescer:
 
     # -- admission ---------------------------------------------------------
 
-    def submit(self, row: int, k: int) -> Future:
+    def submit(self, row: int, k: int, span=None,
+               t_submit: float = 0.0) -> Future:
         """Admit one query; returns its Future. Raises
         :class:`LoadShedError` immediately when the queue is at bound —
-        overload must fail fast, not queue unboundedly."""
+        overload must fail fast, not queue unboundedly.
+
+        ``span``: the request's root tracing span, carried through the
+        pipeline so the completion thread can finish it; an ``enqueue``
+        child span opens here and closes when the dispatcher takes the
+        request — queue time is where an overloaded server's p99 hides,
+        so it must be its own segment in the trace."""
         fut: Future = Future()
+        tracer = get_tracer()
+        # only under a live root: an unsampled request (head sampling,
+        # obs/trace.py) must create zero spans anywhere downstream —
+        # a parentless enqueue here would start an orphan trace
+        enq = (
+            tracer.start_span(
+                "serve.enqueue", parent=span.context, row=int(row)
+            )
+            if span is not None
+            else None
+        )
         with self._lock:
             if self._closing:
+                # seal the just-opened enqueue segment before bailing:
+                # an unfinished span never lands in the ring, and the
+                # trace would silently lose its queue segment
+                tracer.finish(enq, outcome="closed")
                 raise ServiceClosed("serving layer is shut down")
             if len(self._queue) >= self.queue_depth:
                 self.shed_count += 1
                 shed = self.shed_count
+                self._m_shed.inc()
+                tracer.finish(enq, outcome="shed")
                 # stderr echo only every 100th shed: under sustained
                 # overload the event stream must not become the load
                 runtime_event(
@@ -157,8 +229,10 @@ class Coalescer:
                 )
             self._queue.append(
                 Request(row=int(row), k=int(k), future=fut,
-                        t_enqueue=time.monotonic())
+                        t_enqueue=time.monotonic(), span=span,
+                        enq_span=enq, t_submit=t_submit)
             )
+            self._m_queue_depth.set(len(self._queue))
             self._not_empty.notify()
         return fut
 
@@ -191,11 +265,14 @@ class Coalescer:
         return batch
 
     def _dispatch_loop(self) -> None:
+        tracer = get_tracer()
         while True:
             batch = self._take_batch()
             if batch is None:
                 self._inflight.put(None)  # completion-thread shutdown
                 return
+            with self._lock:
+                self._m_queue_depth.set(len(self._queue))
             rows = np.array([r.row for r in batch], dtype=np.int64)
             k = max(r.k for r in batch)
             bucket = bk.bucket_for(rows.shape[0], self.buckets)
@@ -203,14 +280,57 @@ class Coalescer:
             wait_ms = (
                 time.monotonic() - batch[0].t_enqueue
             ) * 1e3
+            # The thread hop: the batch's dispatch span parents to the
+            # first TRACED request's root (a span has exactly one
+            # parent), so that head trace contains the device work
+            # directly. Every member's enqueue span (opened on its
+            # submitter thread) closes here carrying
+            # batch_span=<trace>:<span> naming the shared dispatch span
+            # — the link non-head traces reach the device work through,
+            # and what the bench audit resolves. A batch with no traced
+            # member (head sampling) creates no spans at all.
+            head = next((r for r in batch if r.span is not None), None)
+            dispatch = (
+                tracer.start_span(
+                    "serve.dispatch", parent=head.span.context,
+                    n=len(batch), bucket=bucket, k=k,
+                )
+                if head is not None
+                else None
+            )
+            link = (
+                f"{dispatch.trace_id}:{dispatch.span_id}"
+                if dispatch is not None else None
+            )
+            for r in batch:
+                if link is not None:
+                    tracer.finish(r.enq_span, batch_span=link)
+                else:
+                    tracer.finish(r.enq_span)
+            self._m_occupancy.observe(len(batch), bucket=bucket)
+            self._m_wait.observe(wait_ms / 1e3)
             try:
-                handle = self._issue(padded, k)
+                dev = (
+                    tracer.start_span(
+                        "serve.device_execute",
+                        parent=dispatch.context, bucket=bucket,
+                    )
+                    if dispatch is not None
+                    else None
+                )
+                try:
+                    handle = self._issue(padded, k)
+                finally:
+                    tracer.finish(dev)
             except BaseException as exc:  # route, don't kill the thread
+                tracer.finish(dispatch, error=repr(exc))
                 for r in batch:
                     r.future.set_exception(exc)
+                    tracer.finish(r.span, outcome="error")
                 with self._lock:
                     self._inflight_n -= 1
                 continue
+            tracer.finish(dispatch)
             self.batch_count += 1
             self.dispatched_requests += len(batch)
             if self._on_batch is not None:
@@ -220,20 +340,36 @@ class Coalescer:
                         wait_ms=wait_ms,
                     )
                 )
-            self._inflight.put((handle, rows, batch, k))
+            self._inflight.put(
+                (handle, rows, batch, k,
+                 dispatch.context if dispatch else None)
+            )
 
     def _complete_loop(self) -> None:
+        tracer = get_tracer()
         while True:
             item = self._inflight.get()
             if item is None:
                 return
-            handle, rows, batch, k = item
+            handle, rows, batch, k, dispatch_ctx = item
             try:
-                self._complete(handle, rows, batch, k)
+                # activate() re-roots this worker thread into the
+                # batch's trace: spans the completion callback opens
+                # (host transfer, cache fill) parent under it.
+                # child_span, not span: a batch whose traced head was
+                # sampled out (ctx None) must not start orphan traces.
+                with tracer.activate(dispatch_ctx):
+                    with tracer.child_span("serve.complete", n=len(batch)):
+                        self._complete(handle, rows, batch, k)
             except BaseException as exc:
                 for r in batch:
+                    # same guard for span and future: members the
+                    # completion callback already resolved (and whose
+                    # root span it already finished) must not be
+                    # re-marked as errors
                     if not r.future.done():
                         r.future.set_exception(exc)
+                        tracer.finish(r.span, outcome="error")
             finally:
                 with self._lock:
                     self._inflight_n -= 1
@@ -258,7 +394,10 @@ class Coalescer:
             pending = list(self._queue)
             self._queue.clear()
             self._not_empty.notify_all()
+        tracer = get_tracer()
         for r in pending:
             r.future.set_exception(ServiceClosed("serving layer shut down"))
+            tracer.finish(r.enq_span, outcome="closed")
+            tracer.finish(r.span, outcome="closed")
         self._dispatcher.join(timeout=10)
         self._completer.join(timeout=10)

@@ -16,6 +16,7 @@ Requests::
     {"id": 5, "op": "invalidate"}
     {"id": 6, "op": "ping"}
     {"id": 7, "op": "shutdown"}
+    {"id": 9, "op": "metrics"}
     {"id": 8, "op": "update",
      "add_nodes": [{"type": "author", "id": "a_new", "label": "A. New"}],
      "add_edges": [{"rel": "author_of", "src": "a_new", "dst": "paper_7"}],
@@ -27,6 +28,13 @@ XLA compiles in steady state, and only the affected rows' cache entries
 are invalidated. Its result reports which path ran (``mode``:
 ``delta`` | ``rebuild``), how many score rows the change touched, and
 the new chained fingerprint.
+
+The ``metrics`` op is the live-aggregates endpoint (obs/): per-op
+latency quantiles (p50/p95/p99 from the streaming histograms — no
+samples stored, no logs replayed), cache hit rates per tier, and the
+full registry snapshot for tooling. Every op's wall time is also
+observed into ``dpathsim_request_seconds{op=...}`` here — the protocol
+layer is where "request latency per protocol op" is defined.
 
 Responses mirror the id and carry ``ok``; successes add ``result`` and
 ``latency_ms``, failures add ``error``. Unknown ops / bad JSON are
@@ -40,65 +48,150 @@ import json
 import time
 from typing import IO
 
+from ..obs.metrics import get_registry
+from ..obs.trace import get_tracer
 from .service import PathSimService
 
 _QUERY_KEYS = ("source", "source_id", "row")
+
+# op → (latency-histogram cell, error-counter cell), bound on first use
+# so the steady-state path pays cell increments, never registry/label
+# lookups (the bind-once discipline service.py and cache.py follow).
+# Op cardinality is the fixed protocol vocabulary plus whatever unknown
+# op names clients send — those error out and are rare by definition.
+_OP_CELLS: dict[str, tuple] = {}
+
+
+def _op_cells(op: str) -> tuple:
+    cells = _OP_CELLS.get(op)
+    if cells is None:
+        reg = get_registry()
+        cells = _OP_CELLS[op] = (
+            reg.histogram(
+                "dpathsim_request_seconds",
+                "protocol request wall time by op",
+            ).labels(op=op),
+            reg.counter(
+                "dpathsim_request_errors_total", "failed protocol requests"
+            ).labels(op=op),
+        )
+    return cells
+
+
+def _hit_rate(hits: int, misses: int) -> float | None:
+    total = hits + misses
+    return None if total == 0 else round(hits / total, 6)
+
+
+def metrics_snapshot(service: PathSimService) -> dict:
+    """The ``metrics`` op payload: derived summaries first (what an
+    operator asks for), full registry snapshot last (what tooling
+    scrapes). The cache hit counts come from the same per-instance
+    counters ``stats()`` reports, so the two views can never disagree."""
+    reg = get_registry()
+    snap = reg.snapshot()  # once: the op summaries below read from it
+    ops: dict[str, dict] = {}
+    fam = snap.get("dpathsim_request_seconds")
+    if fam:
+        for entry in fam["values"]:
+            if not entry["count"]:
+                continue  # bound-but-never-observed cell: no summary
+            name = entry["labels"].get("op", "?")
+            ops[name] = {
+                "count": entry["count"],
+                "p50_ms": round(entry["p50"] * 1e3, 4),
+                "p95_ms": round(entry["p95"] * 1e3, 4),
+                "p99_ms": round(entry["p99"] * 1e3, 4),
+                "mean_ms": round(
+                    entry["sum"] / max(entry["count"], 1) * 1e3, 4
+                ),
+            }
+    rc, tc = service.result_cache, service.tile_cache
+    return {
+        "ops": ops,
+        "caches": {
+            "result": {
+                "hits": rc.hits, "misses": rc.misses,
+                "hit_rate": _hit_rate(rc.hits, rc.misses),
+            },
+            "tile": {
+                "hits": tc.hits, "misses": tc.misses,
+                "hit_rate": _hit_rate(tc.hits, tc.misses),
+            },
+        },
+        "enabled": {
+            "metrics": reg.enabled, "tracing": get_tracer().enabled,
+        },
+        "registry": snap,
+    }
+
+
+def _dispatch_op(service: PathSimService, op: str, req: dict):
+    """The op table: one request's work, exceptions propagating to the
+    caller's per-request error envelope."""
+    if op == "ping":
+        return {"pong": True}
+    if op == "stats":
+        return service.stats()
+    if op == "metrics":
+        return metrics_snapshot(service)
+    if op == "invalidate":
+        service.invalidate()
+        return {"invalidated": True}
+    if op == "topk":
+        kwargs = {key: req.get(key) for key in _QUERY_KEYS}
+        if all(v is None for v in kwargs.values()):
+            raise KeyError("topk needs one of source / source_id / row")
+        hits = service.topk(k=req.get("k"), **kwargs)
+        return {
+            "topk": [
+                {"id": i, "label": lab, "score": s} for i, lab, s in hits
+            ]
+        }
+    if op == "update":
+        from ..data.delta import delta_from_records
+
+        delta = delta_from_records(
+            service.hin,
+            add_nodes=req.get("add_nodes", ()),
+            add_edges=req.get("add_edges", ()),
+            remove_edges=req.get("remove_edges", ()),
+        )
+        return service.update(delta)
+    if op == "scores":
+        row = service.resolve(
+            source=req.get("source"),
+            source_id=req.get("source_id"),
+            row=req.get("row"),
+        )
+        return {"row": row, "scores": service.scores_index(row).tolist()}
+    raise KeyError(f"unknown op {op!r}")
 
 
 def handle_request(service: PathSimService, req: dict) -> dict:
     """One request dict → one response dict (transport-free core)."""
     rid = req.get("id")
     op = req.get("op", "topk")
+    latency_cell, error_cell = _op_cells(op)
     t0 = time.perf_counter()
     try:
-        if op == "ping":
-            result = {"pong": True}
-        elif op == "stats":
-            result = service.stats()
-        elif op == "invalidate":
-            service.invalidate()
-            result = {"invalidated": True}
-        elif op == "topk":
-            kwargs = {key: req.get(key) for key in _QUERY_KEYS}
-            if all(v is None for v in kwargs.values()):
-                raise KeyError(
-                    "topk needs one of source / source_id / row"
-                )
-            hits = service.topk(k=req.get("k"), **kwargs)
-            result = {
-                "topk": [
-                    {"id": i, "label": lab, "score": s}
-                    for i, lab, s in hits
-                ]
-            }
-        elif op == "update":
-            from ..data.delta import delta_from_records
-
-            delta = delta_from_records(
-                service.hin,
-                add_nodes=req.get("add_nodes", ()),
-                add_edges=req.get("add_edges", ()),
-                remove_edges=req.get("remove_edges", ()),
-            )
-            result = service.update(delta)
-        elif op == "scores":
-            row = service.resolve(
-                source=req.get("source"),
-                source_id=req.get("source_id"),
-                row=req.get("row"),
-            )
-            result = {"row": row,
-                      "scores": service.scores_index(row).tolist()}
-        else:
-            raise KeyError(f"unknown op {op!r}")
+        # protocol-level span: the outermost segment of a served
+        # request's trace (the serve.request root parents under it on
+        # query ops)
+        with get_tracer().span("serve.op", op=op):
+            result = _dispatch_op(service, op, req)
     except Exception as exc:  # per-request failure, not process failure
+        latency_cell.observe(time.perf_counter() - t0)
+        error_cell.inc()
         msg = exc.args[0] if exc.args else repr(exc)
         return {"id": rid, "ok": False, "error": str(msg)}
+    latency_s = time.perf_counter() - t0
+    latency_cell.observe(latency_s)
     return {
         "id": rid,
         "ok": True,
         "result": result,
-        "latency_ms": round((time.perf_counter() - t0) * 1e3, 3),
+        "latency_ms": round(latency_s * 1e3, 3),
     }
 
 

@@ -33,6 +33,8 @@ from typing import Sequence
 import numpy as np
 
 from ..backends.base import PathSimBackend
+from ..obs.metrics import get_registry
+from ..obs.trace import get_tracer
 from ..ops import pathsim
 from ..utils.logging import runtime_event
 from . import buckets as bk
@@ -91,6 +93,25 @@ class PathSimService:
             )
         )
         self._update_stats = {"deltas": 0, "rebuilds": 0, "purged_rows": 0}
+        # obs handles, bound once per service (hot-path discipline: a
+        # request pays cell increments, never registry lookups)
+        reg = get_registry()
+        self._m_latency = {
+            outcome: reg.histogram(
+                "dpathsim_serve_request_seconds",
+                "submit-to-resolve request latency by outcome",
+            ).labels(outcome=outcome)
+            for outcome in ("hit_result", "hit_tile", "dispatch")
+        }
+        self._m_updates = reg.counter(
+            "dpathsim_serve_updates_total",
+            "delta-update outcomes (patch vs rebuild)",
+        )
+        # XLA compiles visible live: a steady-state serving process
+        # whose counter moves is violating the shape-bucket contract
+        from ..utils.xla_flags import install_compile_metrics
+
+        install_compile_metrics()
         self._install_backend(backend, warm=self.config.warm)
         self.coalescer = Coalescer(
             issue=self._issue,
@@ -171,29 +192,48 @@ class PathSimService:
     ) -> None:
         """Completion-thread half: fetch counts, normalize in f64, top-k
         per request (each gets the k-prefix it asked for), fill both
-        cache tiers, resolve futures."""
-        # column trim to the logical width: device handles from a
-        # capacity-padded backend carry zero-count pad columns
-        counts = np.asarray(handle, dtype=np.float64)[
-            : rows.shape[0], : self.n
-        ]
+        cache tiers, resolve futures. The tracer spans opened here
+        parent into the batch's ``serve.complete`` span — the coalescer
+        activated its context on this thread before calling."""
+        tracer = get_tracer()
+        with tracer.child_span("serve.host_transfer", n=int(rows.shape[0])):
+            # column trim to the logical width: device handles from a
+            # capacity-padded backend carry zero-count pad columns.
+            # np.asarray is where an async device handle actually
+            # blocks — the transfer segment of the trace.
+            counts = np.asarray(handle, dtype=np.float64)[
+                : rows.shape[0], : self.n
+            ]
         scores = pathsim.score_rows(counts, self._d[rows], self._d, xp=np)
         masked = scores.copy()
         masked[np.arange(rows.shape[0]), rows] = -np.inf
         k_eff = min(k, max(self.n - 1, 1))
         vals, idxs = pathsim.topk_from_score_rows(masked, k_eff)
-        for b, req in enumerate(batch):
-            epoch = self._epoch_for(int(rows[b]))
-            # copy, not a view: a cached view would pin the whole [B, N]
-            # batch array long past the byte budget's accounting
-            self.tile_cache.put_row(epoch, int(rows[b]), scores[b].copy())
-            kr = min(req.k, k_eff)
-            rv, ri = vals[b, :kr], idxs[b, :kr]
-            self.result_cache.put(
-                (*epoch, int(rows[b]), req.k), rv, ri
-            )
-            if not req.future.done():
-                req.future.set_result((rv, ri))
+        with tracer.child_span("serve.cache_fill", n=len(batch)):
+            for b, req in enumerate(batch):
+                epoch = self._epoch_for(int(rows[b]))
+                # copy, not a view: a cached view would pin the whole
+                # [B, N] batch array long past the byte budget's
+                # accounting
+                self.tile_cache.put_row(
+                    epoch, int(rows[b]), scores[b].copy()
+                )
+                kr = min(req.k, k_eff)
+                rv, ri = vals[b, :kr], idxs[b, :kr]
+                self.result_cache.put(
+                    (*epoch, int(rows[b]), req.k), rv, ri
+                )
+                if not req.future.done():
+                    req.future.set_result((rv, ri))
+                # observed AFTER the future resolves, from the
+                # SUBMITTER's clock reading (t_submit, same origin the
+                # hit_result/hit_tile outcomes use): the histogram's
+                # claim is submit-to-resolve, so cache-fill time and
+                # swap-lock wait must be inside it, not carved out
+                self._m_latency["dispatch"].observe(
+                    time.monotonic() - (req.t_submit or req.t_enqueue)
+                )
+                tracer.finish(req.span, outcome="dispatch")
 
     def _record_batch(self, stats: BatchStats) -> None:
         self._bucket_hist[stats.bucket] = (
@@ -226,22 +266,38 @@ class PathSimService:
     def submit_topk(self, row: int, k: int | None = None) -> Future:
         """Admit a top-k query; returns a Future of (values, indices).
         Cache hits resolve immediately; misses ride the coalescer.
-        Raises :class:`coalescer.LoadShedError` at the queue bound."""
-        k = int(k or self.config.k_default)
-        with self._swap_lock:
-            return self._submit_topk_locked(int(row), k)
+        Raises :class:`coalescer.LoadShedError` at the queue bound.
 
-    def _submit_topk_locked(self, row: int, k: int) -> Future:
+        Every admission opens a root ``serve.request`` span: cache hits
+        finish it here; coalesced misses carry it across the
+        dispatcher/completer thread hop, so one request = one connected
+        trace (enqueue → dispatch → device → transfer → cache fill)."""
+        k = int(k or self.config.k_default)
+        tracer = get_tracer()
+        root = tracer.start_span("serve.request", row=int(row), k=k)
+        t0 = time.monotonic()
+        try:
+            with self._swap_lock:
+                return self._submit_topk_locked(int(row), k, root, t0)
+        except BaseException as exc:
+            tracer.finish(root, outcome=type(exc).__name__)
+            raise
+
+    def _submit_topk_locked(self, row: int, k: int, root=None,
+                            t0: float = 0.0) -> Future:
         # Under _swap_lock: a reload drains the pipeline then swaps the
         # backend — admissions must not interleave with that swap (the
         # drain would never finish, and a request could resolve rows
         # against one graph and dispatch against another).
+        tracer = get_tracer()
         epoch = self._epoch_for(row)
         key = (*epoch, int(row), k)
         hit = self.result_cache.get(key)
         if hit is not None:
             fut: Future = Future()
             fut.set_result(hit)
+            self._m_latency["hit_result"].observe(time.monotonic() - t0)
+            tracer.finish(root, outcome="hit_result")
             return fut
         srow = self.tile_cache.get_row(epoch, int(row))
         if srow is not None:
@@ -254,8 +310,10 @@ class PathSimService:
             self.result_cache.put(key, vals[0], idxs[0])
             fut = Future()
             fut.set_result((vals[0], idxs[0]))
+            self._m_latency["hit_tile"].observe(time.monotonic() - t0)
+            tracer.finish(root, outcome="hit_tile")
             return fut
-        return self.coalescer.submit(int(row), k)
+        return self.coalescer.submit(int(row), k, span=root, t_submit=t0)
 
     def topk_index(self, row: int, k: int | None = None):
         """Synchronous top-k by dense row index → (values, indices)."""
@@ -374,6 +432,11 @@ class PathSimService:
                 self._update_stats["deltas"] += 1
                 self._update_stats["purged_rows"] += purged
             ms = round((time.perf_counter() - t0) * 1e3, 3)
+            self._m_updates.inc(mode=mode)
+            get_registry().histogram(
+                "dpathsim_serve_update_seconds",
+                "delta-update end-to-end latency by mode",
+            ).observe((time.perf_counter() - t0), mode=mode)
             runtime_event(
                 "serve_update",
                 mode=mode,
@@ -418,7 +481,24 @@ class PathSimService:
     def stats(self) -> dict:
         c = self.coalescer
         batches = max(c.batch_count, 1)
+        # live latency quantiles from the obs registry — the extended
+        # snapshot: stats() answers "where is the p99 right now" without
+        # anyone replaying JSONL
+        lat = {}
+        for outcome, cell in self._m_latency.items():
+            if cell.count:
+                lat[outcome] = {
+                    "count": cell.count,
+                    "p50_ms": round(cell.quantile(0.50) * 1e3, 4),
+                    "p95_ms": round(cell.quantile(0.95) * 1e3, 4),
+                    "p99_ms": round(cell.quantile(0.99) * 1e3, 4),
+                }
         return {
+            "obs": {
+                "latency": lat,
+                "tracing": get_tracer().enabled,
+                "metrics": get_registry().enabled,
+            },
             "n": self.n,
             "metapath": self.metapath.name,
             "variant": self.variant,

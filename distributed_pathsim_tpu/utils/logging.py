@@ -25,8 +25,27 @@ from __future__ import annotations
 
 import json
 import sys
+import threading
 import time
 from typing import IO, Any
+
+from ..obs.metrics import get_registry
+
+# -- clock discipline --------------------------------------------------------
+#
+# Two clocks, two jobs, never mixed: time.time() (wall) is for HUMANS and
+# cross-process joins; time.monotonic() is for ORDERING and durations (it
+# never steps backward under NTP). Every JSONL event carries both, stamped
+# by this one helper — the same monotonic clock the tracer's spans use
+# (obs/trace.py), so events and spans join on the ts_mono axis. This is
+# the only sanctioned time.time() call site in the package
+# (scripts/lint_telemetry.py enforces it).
+
+
+def timestamps() -> dict[str, float]:
+    """One wall + monotonic stamp pair, read back to back."""
+    return {"ts": time.time(), "ts_mono": time.monotonic()}
+
 
 # -- runtime events (resilience channel) -----------------------------------
 #
@@ -35,16 +54,24 @@ from typing import IO, Any
 # numpy is a debugging trap. Every such event goes through runtime_event():
 # one structured line on stderr (never stdout — the reference grammar owns
 # stdout), plus the JSONL metrics channel when a RunLogger is registered
-# as the process-wide sink (the CLI registers its logger for the run).
+# as the process-wide sink (the CLI registers its logger for the run),
+# plus a per-event counter in the obs registry (the live aggregate the
+# ``metrics`` protocol op and the Prometheus textfile expose).
 
 _EVENT_SINK: "RunLogger | None" = None
+# One lock for sink swaps AND stderr writes: runtime_event fires from the
+# coalescer's worker threads concurrently with the main thread, so an
+# unguarded sink swap could emit into a half-closed logger and two stderr
+# prints could interleave their characters mid-line.
+_EVENT_LOCK = threading.Lock()
 
 
 def set_event_sink(logger: "RunLogger | None") -> None:
     """Register (or clear, with None) the RunLogger whose JSONL metrics
     channel receives runtime events."""
     global _EVENT_SINK
-    _EVENT_SINK = logger
+    with _EVENT_LOCK:
+        _EVENT_SINK = logger
 
 
 def runtime_event(event: str, echo: bool = True, **fields: Any) -> None:
@@ -52,19 +79,25 @@ def runtime_event(event: str, echo: bool = True, **fields: Any) -> None:
 
     stderr rendering: ``[pathsim:EVENT] k=v k=v``; machine rendering: a
     metrics-JSONL record ``{"event": EVENT, ...fields}`` on the
-    registered sink. Values are stringified for stderr but passed
-    through for JSONL (callers pre-repr exceptions).
+    registered sink, plus ``dpathsim_events_total{event=...}`` in the
+    obs registry. Values are stringified for stderr but passed through
+    for JSONL (callers pre-repr exceptions).
 
     ``echo=False`` suppresses only the stderr line (the JSONL record
     always lands): high-rate serving events (per-batch accounting,
     sustained load shedding) must not turn the operator channel into
     the bottleneck, but still need to be machine-visible."""
-    if echo:
-        rendered = " ".join(f"{k}={v}" for k, v in fields.items())
-        print(f"[pathsim:{event}] {rendered}".rstrip(), file=sys.stderr)
-    sink = _EVENT_SINK
-    if sink is not None:
-        sink.metric(event=event, **fields)
+    get_registry().counter(
+        "dpathsim_events_total", "runtime_event emissions by event name"
+    ).inc(event=event)
+    with _EVENT_LOCK:
+        if echo:
+            rendered = " ".join(f"{k}={v}" for k, v in fields.items())
+            # one write call, trailing newline included: the line lands
+            # atomically even when worker threads emit concurrently
+            sys.stderr.write(f"[pathsim:{event}] {rendered}".rstrip() + "\n")
+        if _EVENT_SINK is not None:
+            _EVENT_SINK.metric(event=event, **fields)
 
 
 class RunLogger:
@@ -85,6 +118,10 @@ class RunLogger:
         self._metrics: IO[str] | None = (
             open(metrics_path, "a", encoding="utf-8") if metrics_path else None
         )
+        # The JSONL channel is written from multiple threads (the CLI's
+        # main thread, coalescer workers via runtime_event): one lock
+        # keeps each record on its own line and close() race-free.
+        self._metrics_lock = threading.Lock()
         self.overall_start = time.perf_counter()
 
     # -- reference grammar -------------------------------------------------
@@ -118,10 +155,17 @@ class RunLogger:
     # -- structured channel (new capability) -------------------------------
 
     def metric(self, **fields: Any) -> None:
-        if self._metrics is not None:
-            fields.setdefault("ts", time.time())
-            self._metrics.write(json.dumps(fields) + "\n")
-            self._metrics.flush()
+        # Both clocks from the one helper (see timestamps()): ts for
+        # humans/joins across processes, ts_mono for ordering and
+        # joining with span timestamps — a duration must never be
+        # computed from ts (wall time steps under NTP).
+        stamps = timestamps()
+        fields.setdefault("ts", stamps["ts"])
+        fields.setdefault("ts_mono", stamps["ts_mono"])
+        with self._metrics_lock:
+            if self._metrics is not None:
+                self._metrics.write(json.dumps(fields) + "\n")
+                self._metrics.flush()
 
     # -- plumbing ----------------------------------------------------------
 
@@ -157,6 +201,7 @@ class RunLogger:
         # after close(), grammar writes and metric() are both no-ops.
         self._close_grammar_file()
         self._output_path = None
-        if self._metrics is not None:
-            self._metrics.close()
-            self._metrics = None
+        with self._metrics_lock:
+            if self._metrics is not None:
+                self._metrics.close()
+                self._metrics = None

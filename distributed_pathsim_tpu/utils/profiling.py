@@ -2,9 +2,17 @@
 
 The reference's entire observability is ``timeit.default_timer`` deltas
 written to its log (``DPathSim_APVPA.py:26,37,63,67``). StageTimer keeps
-that capability behind a context manager; ``device_trace`` adds what the
+that capability — but since the obs subsystem (obs/) exists it is a
+**thin shim over the tracer**: every ``stage()`` opens a hierarchical
+span named ``stage:<name>`` (visible in ``--trace-out`` Perfetto dumps,
+nested under whatever span is current), records the duration into the
+``dpathsim_stage_seconds`` histogram, and still appends to ``.stages``
+and emits the ``stage_time`` JSONL event — the engine/driver/test
+callers of the old API run unchanged. ``device_trace`` adds what the
 reference never had — a real ``jax.profiler`` trace (XLA op timeline,
-HBM usage) viewable in TensorBoard/Perfetto.
+HBM usage) viewable in TensorBoard/Perfetto; while it is open, tracer
+spans also annotate the device timeline (``device_annotations``), so
+the host hierarchy and the XLA ops land in one view.
 """
 
 from __future__ import annotations
@@ -15,9 +23,17 @@ from typing import Iterator
 
 import jax
 
+from ..obs.metrics import get_registry
+from ..obs.trace import get_tracer
+
 
 class StageTimer:
-    """Accumulates named stage timings; integrates with RunLogger.metric."""
+    """Accumulates named stage timings; integrates with RunLogger.metric.
+
+    Compat shim (deprecated entry point, kept working): new code should
+    open tracer spans directly — this class exists so every pre-obs
+    ``timer.stage(...)`` call site keeps its exact behavior while also
+    feeding the span tree and the stage-duration histogram."""
 
     def __init__(self, logger=None):
         self.stages: list[tuple[str, float]] = []
@@ -27,10 +43,14 @@ class StageTimer:
     def stage(self, name: str) -> Iterator[None]:
         t0 = time.perf_counter()
         try:
-            yield
+            with get_tracer().span(f"stage:{name}"):
+                yield
         finally:
             dt = time.perf_counter() - t0
             self.stages.append((name, dt))
+            get_registry().histogram(
+                "dpathsim_stage_seconds", "pipeline stage durations"
+            ).observe(dt, stage=name)
             if self._logger is not None:
                 self._logger.metric(event="stage_time", stage=name, seconds=dt)
 
@@ -46,12 +66,18 @@ class StageTimer:
 
 @contextlib.contextmanager
 def device_trace(log_dir: str | None) -> Iterator[None]:
-    """jax.profiler trace scope; no-op when log_dir is None."""
+    """jax.profiler trace scope; no-op when log_dir is None. While
+    open, obs tracer spans mirror into the device timeline as
+    TraceAnnotations so one Perfetto view carries both hierarchies."""
     if log_dir is None:
         yield
         return
+    tracer = get_tracer()
+    was_annotating = tracer.device_annotations
     jax.profiler.start_trace(log_dir)
+    tracer.configure(device_annotations=True)
     try:
         yield
     finally:
+        tracer.configure(device_annotations=was_annotating)
         jax.profiler.stop_trace()

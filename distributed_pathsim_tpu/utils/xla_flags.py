@@ -78,6 +78,43 @@ def warm_compile_cache(
     return times
 
 
+_COMPILE_METRICS_INSTALLED = False
+
+
+def install_compile_metrics() -> bool:
+    """Register a PROCESS-LIFETIME jax.monitoring listener that counts
+    every XLA backend compile into the obs registry
+    (``dpathsim_xla_compiles_total``) — the always-on companion to the
+    scoped :class:`CompileCounter` below, reusing the same event hook.
+    A steady-state serving process whose counter moves is recompiling,
+    which the shape-bucket/delta contracts forbid; the ``metrics``
+    protocol op and the Prometheus textfile make that visible live.
+
+    Idempotent (one listener no matter how many services start) and
+    best-effort (exotic jax versions without the monitoring module just
+    skip it). Returns whether the hook is installed."""
+    global _COMPILE_METRICS_INSTALLED
+    if _COMPILE_METRICS_INSTALLED:
+        return True
+    try:
+        from jax._src import monitoring
+
+        from ..obs.metrics import get_registry
+
+        def _on_event(name: str, value, **kwargs) -> None:
+            if name.endswith(CompileCounter._EVENT_SUFFIX):
+                get_registry().counter(
+                    "dpathsim_xla_compiles_total",
+                    "XLA backend compilations since process start",
+                ).inc()
+
+        monitoring.register_event_duration_secs_listener(_on_event)
+    except Exception:
+        return False
+    _COMPILE_METRICS_INSTALLED = True
+    return True
+
+
 class CompileCounter:
     """Counts XLA backend compiles via jax.monitoring — the
     zero-new-compiles assertion hook for the delta-serving contract

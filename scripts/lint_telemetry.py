@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""Telemetry discipline lint: keep the obs subsystem the only door.
+
+The observability layer (obs/) only stays trustworthy if new code can't
+quietly bypass it. Three rules, each one a regression class this repo
+has actually had:
+
+R1  ``time.time()`` outside the sanctioned sites. Wall clock is for
+    humans; durations and orderings use ``perf_counter``/``monotonic``
+    (wall time steps under NTP — a duration computed from it can be
+    negative). Sanctioned: ``utils/logging.py`` (the ``timestamps()``
+    helper stamping JSONL ``ts``) and ``obs/trace.py`` (the tracer's
+    one wall anchor mapping monotonic spans onto epoch time).
+
+R2  ``print(..., file=sys.stderr)`` outside the CLI surface. Library
+    code reporting through raw stderr prints is invisible to the JSONL
+    sink, the obs counters, AND can interleave mid-line across threads
+    — that's what ``runtime_event`` exists for. Sanctioned: the CLI
+    modules' user-facing one-liners (error renderings, banners) and
+    ``utils/logging.py`` itself.
+
+R3  ``_EVENT_SINK`` outside ``utils/logging.py``. Writing to the sink
+    directly skips the lock, the obs event counter, and the stderr
+    echo policy — the exact bypass the sink's lock exists to prevent.
+
+Runs as ``make lint-telemetry`` and as a non-slow pytest
+(tests/test_obs.py::test_lint_telemetry), so tier-1 catches a new
+violation the moment it lands.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import pathlib
+import re
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+PACKAGE = REPO / "distributed_pathsim_tpu"
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    name: str
+    pattern: re.Pattern
+    why: str
+    # relative paths (from the package root) wholly exempt from the rule
+    allowed_files: frozenset[str]
+
+
+RULES = (
+    Rule(
+        name="wall-clock-duration",
+        pattern=re.compile(r"\btime\.time\(\)"),
+        why=(
+            "time.time() is wall clock — durations/ordering must use "
+            "perf_counter/monotonic; stamp events via "
+            "utils.logging.timestamps()"
+        ),
+        allowed_files=frozenset({"utils/logging.py", "obs/trace.py"}),
+    ),
+    Rule(
+        name="raw-stderr-print",
+        pattern=re.compile(r"print\([^)]*file\s*=\s*sys\.stderr"),
+        why=(
+            "library code reports through runtime_event() (JSONL sink + "
+            "obs counter + locked stderr), not raw stderr prints"
+        ),
+        allowed_files=frozenset(
+            {"utils/logging.py", "cli.py", "serving/cli.py",
+             "neural_cli.py"}
+        ),
+    ),
+    Rule(
+        name="event-sink-bypass",
+        pattern=re.compile(r"_EVENT_SINK"),
+        why=(
+            "the event sink is private to utils/logging.py — emitting "
+            "through it directly skips the lock and the obs counters; "
+            "call runtime_event()"
+        ),
+        allowed_files=frozenset({"utils/logging.py"}),
+    ),
+)
+
+# print(...) spanning lines would dodge a per-line regex; scan whole
+# files with a multiline-tolerant pass instead of per-line matching.
+_COMMENT = re.compile(r"^\s*#")
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    rule: str
+    path: str
+    line: int
+    text: str
+    why: str
+
+    def render(self) -> str:
+        return (
+            f"{self.path}:{self.line}: [{self.rule}] {self.text.strip()}"
+            f"\n    -> {self.why}"
+        )
+
+
+def scan_file(path: pathlib.Path, rel: str) -> list[Violation]:
+    out: list[Violation] = []
+    try:
+        lines = path.read_text(encoding="utf-8").splitlines()
+    except OSError:
+        return out
+    for rule in RULES:
+        if rel in rule.allowed_files:
+            continue
+        for i, line in enumerate(lines, 1):
+            if _COMMENT.match(line):
+                continue
+            if rule.pattern.search(line):
+                out.append(
+                    Violation(
+                        rule=rule.name, path=f"distributed_pathsim_tpu/{rel}",
+                        line=i, text=line, why=rule.why,
+                    )
+                )
+    return out
+
+
+def scan_package() -> list[Violation]:
+    violations: list[Violation] = []
+    for path in sorted(PACKAGE.rglob("*.py")):
+        rel = path.relative_to(PACKAGE).as_posix()
+        violations.extend(scan_file(path, rel))
+    return violations
+
+
+def main() -> int:
+    violations = scan_package()
+    if not violations:
+        print(f"lint_telemetry: clean ({len(list(PACKAGE.rglob('*.py')))} "
+              "files scanned)")
+        return 0
+    for v in violations:
+        print(v.render(), file=sys.stderr)
+    print(f"lint_telemetry: {len(violations)} violation(s)",
+          file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,745 @@
+"""Observability subsystem: histograms, spans, exporters, protocol op.
+
+The load-bearing guarantees:
+
+- streaming histogram quantiles track ``numpy.percentile`` within the
+  documented geometric-bucket error bound on adversarial distributions
+  (bimodal, heavy-tail, entirely below bucket-min, entirely above
+  bucket-max) — WITHOUT storing samples;
+- one served request is one connected trace across the coalescer's
+  submit → dispatch → complete thread hops, under concurrent load;
+- the Prometheus textfile is well-formed exposition format 0.0.4
+  (cumulative buckets, ``+Inf`` == ``_count``) and is written
+  atomically;
+- the ``metrics`` protocol op round-trips through JSON and its cache
+  hit counts agree exactly with the service-level cache counters;
+- telemetry discipline (scripts/lint_telemetry.py) holds over the
+  whole package;
+- the obs-off arm costs nothing measurable and neither arm perturbs
+  the steady-state zero-compile contract (``make obs-smoke``).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import math
+import re
+import threading
+
+import numpy as np
+import pytest
+
+from distributed_pathsim_tpu import obs
+from distributed_pathsim_tpu.backends.base import create_backend
+from distributed_pathsim_tpu.data.synthetic import synthetic_hin
+from distributed_pathsim_tpu.obs.metrics import (
+    MetricsRegistry,
+    geometric_bounds,
+    get_registry,
+)
+from distributed_pathsim_tpu.obs.trace import get_tracer
+from distributed_pathsim_tpu.ops.metapath import compile_metapath
+from distributed_pathsim_tpu.serving import PathSimService, ServeConfig
+
+
+@pytest.fixture(scope="module")
+def hin():
+    return synthetic_hin(160, 260, 9, n_topics=4, seed=7)
+
+
+@pytest.fixture(scope="module")
+def metapath(hin):
+    return compile_metapath("APVPA", hin.schema)
+
+
+@pytest.fixture()
+def tracing():
+    """Enable tracing for one test; restore the process default (off)
+    and drain the span ring afterwards so tests stay independent."""
+    tracer = get_tracer()
+    tracer.clear()
+    tracer.configure(enabled=True, sample_every=1)
+    try:
+        yield tracer
+    finally:
+        tracer.configure(enabled=False, sample_every=1)
+        tracer.clear()
+
+
+def _service(hin, metapath, backend_name="numpy", **cfg):
+    cfg.setdefault("max_wait_ms", 5.0)
+    cfg.setdefault("warm", False)
+    backend = create_backend(backend_name, hin, metapath)
+    return PathSimService(backend, config=ServeConfig(**cfg))
+
+
+# -- histogram quantile accuracy (satellite: adversarial distributions) ---
+
+# Documented worst-case relative error of log-linear interpolation on
+# geometric buckets: one bucket-width ratio, 10^(1/16)-1 ≈ 15.5% at the
+# default resolution.
+_REL_TOL = 10 ** (1 / 16) - 1 + 0.01
+
+
+def _check_quantiles(samples: np.ndarray, qs=(0.50, 0.95, 0.99)) -> None:
+    reg = MetricsRegistry()
+    cell = reg.histogram("h").labels()
+    for v in samples:
+        cell.observe(float(v))
+    for q in qs:
+        est = cell.quantile(q)
+        ref = float(np.percentile(samples, q * 100))
+        assert abs(est - ref) <= _REL_TOL * abs(ref) + 1e-12, (
+            q, est, ref, abs(est - ref) / abs(ref),
+        )
+
+
+def test_histogram_quantiles_bimodal():
+    """Two tight modes three decades apart — the shape a cache-hit/
+    dispatch latency split actually produces."""
+    rng = np.random.default_rng(0)
+    fast = rng.normal(2e-4, 2e-5, size=6000).clip(1e-5)
+    slow = rng.normal(0.25, 0.02, size=4000).clip(1e-3)
+    _check_quantiles(np.concatenate([fast, slow]))
+
+
+def test_histogram_quantiles_heavy_tail():
+    """Lognormal with a fat tail: p99 sits far from the mass."""
+    rng = np.random.default_rng(1)
+    _check_quantiles(np.exp(rng.normal(-6.0, 1.5, size=20000)))
+
+
+def test_histogram_quantiles_below_bucket_min():
+    """Everything under the lowest bound lands in underflow; the only
+    honest answer is the exact observed min (documented edge clamp)."""
+    rng = np.random.default_rng(2)
+    samples = rng.uniform(1e-9, 5e-7, size=500)
+    reg = MetricsRegistry()
+    cell = reg.histogram("h").labels()
+    for v in samples:
+        cell.observe(float(v))
+    for q in (0.50, 0.99):
+        assert cell.quantile(q) == samples.min()
+
+
+def test_histogram_quantiles_above_bucket_max():
+    """Everything over the top bound lands in overflow; quantiles clamp
+    to the exact observed max."""
+    rng = np.random.default_rng(3)
+    samples = rng.uniform(200.0, 900.0, size=500)
+    reg = MetricsRegistry()
+    cell = reg.histogram("h").labels()
+    for v in samples:
+        cell.observe(float(v))
+    for q in (0.50, 0.99):
+        assert cell.quantile(q) == samples.max()
+
+
+def test_histogram_quantile_includes_discrete_tail():
+    """The tail-inclusive rank convention: nine 1 ms requests plus one
+    1 s request has its p99 IN the slow mass — a q·(count−1) walk
+    would land one sample short and report ~1 ms, a 1000× under-report
+    of exactly the signal a latency quantile exists to surface."""
+    reg = MetricsRegistry()
+    cell = reg.histogram("h").labels()
+    for v in [0.001] * 9 + [1.0]:
+        cell.observe(v)
+    assert cell.quantile(0.99) == pytest.approx(1.0, rel=_REL_TOL)
+    assert cell.quantile(0.50) == pytest.approx(0.001, rel=_REL_TOL)
+    # and with only two observations, p99 sits at the slow one
+    cell2 = reg.histogram("h2").labels()
+    cell2.observe(2e-6)
+    cell2.observe(90.0)
+    assert cell2.quantile(0.99) == pytest.approx(90.0, rel=_REL_TOL)
+
+
+def test_histogram_bounds_conflict_is_loud():
+    """A family's bucket geometry belongs to its first registrant;
+    handing a later caller different buckets than it asked for would
+    corrupt its counts silently, so the mismatch raises instead."""
+    reg = MetricsRegistry()
+    reg.histogram("h", bounds=(1.0, 2.0, 4.0))
+    reg.histogram("h")  # no opinion on bounds: reuses the family
+    reg.histogram("h", bounds=(1.0, 2.0, 4.0))  # same bounds: fine
+    with pytest.raises(TypeError):
+        reg.histogram("h", bounds=(1.0, 8.0))
+
+
+def test_histogram_bounded_memory_and_aggregates():
+    """No samples stored: state size is fixed by the bucket geometry,
+    while count/sum/min/max stay exact at any volume."""
+    reg = MetricsRegistry()
+    cell = reg.histogram("h").labels()
+    n_state = len(cell.counts)
+    rng = np.random.default_rng(4)
+    samples = np.exp(rng.normal(-4, 2, size=50_000))
+    for v in samples:
+        cell.observe(float(v))
+    assert len(cell.counts) == n_state  # nothing grew
+    snap = cell.snapshot()
+    assert snap["count"] == samples.size
+    assert snap["min"] == samples.min() and snap["max"] == samples.max()
+    assert math.isclose(snap["sum"], samples.sum(), rel_tol=1e-9)
+    assert snap["p50"] <= snap["p95"] <= snap["p99"]
+
+
+def test_geometric_bounds_shape():
+    b = geometric_bounds(1e-3, 1.0, 8)
+    assert b[0] == 1e-3 and b[-1] >= 1.0
+    ratios = [b[i + 1] / b[i] for i in range(len(b) - 2)]
+    assert all(math.isclose(r, 10 ** (1 / 8), rel_tol=1e-9) for r in ratios)
+    with pytest.raises(ValueError):
+        geometric_bounds(1.0, 0.5)
+
+
+def test_registry_counters_gauges_and_disable():
+    reg = MetricsRegistry()
+    c = reg.counter("c", "help").labels(kind="x")
+    g = reg.gauge("g")
+    h = reg.histogram("h")
+    c.inc()
+    c.inc(2)
+    g.set(7, shard="0")
+    h.observe(0.5)
+    assert c.get() == 3.0
+    snap = reg.snapshot()
+    assert snap["c"]["type"] == "counter"
+    assert snap["g"]["values"][0] == {"labels": {"shard": "0"}, "value": 7.0}
+    assert snap["h"]["values"][0]["count"] == 1
+    with pytest.raises(TypeError):
+        reg.counter("g")  # kind mismatch is a programming error
+    # the global disable switch turns every mutation into a no-op …
+    reg.enabled = False
+    c.inc()
+    g.set(99, shard="0")
+    h.observe(1.0)
+    reg.enabled = True
+    assert c.get() == 3.0
+    assert reg.gauge("g").labels(shard="0").get() == 7.0
+    # … and reset() zeroes IN PLACE so bound cells stay live
+    reg.reset()
+    assert c.get() == 0.0
+    c.inc()
+    assert c.get() == 1.0
+
+
+# -- tracing: hierarchy, cross-thread propagation, ring bound -------------
+
+
+def test_span_nesting_and_ids(tracing):
+    with tracing.span("outer") as outer:
+        with tracing.span("inner") as inner:
+            assert inner.trace_id == outer.trace_id
+            assert inner.parent_id == outer.span_id
+    spans = {s.name: s for s in tracing.spans()}
+    assert spans["outer"].parent_id is None
+    assert spans["outer"].trace_id == spans["outer"].span_id  # root rule
+    assert spans["inner"].t_end_ns >= spans["inner"].t_start_ns
+
+
+def test_span_cross_thread_handoff(tracing):
+    """start_span on one thread, finish + activate on another — the
+    coalescer's exact lifecycle, distilled."""
+    root = tracing.start_span("root")
+    seen = {}
+
+    def worker():
+        with tracing.activate(root.context):
+            with tracing.span("child") as c:
+                seen["child"] = c
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    tracing.finish(root)
+    assert seen["child"].trace_id == root.trace_id
+    assert seen["child"].parent_id == root.span_id
+    # the two halves ran on different threads, and the trace knows
+    names = {s.name: s.thread_name for s in tracing.spans()}
+    assert names["child"] != names["root"]
+
+
+def test_span_ring_is_bounded(tracing):
+    tracing.configure(max_spans=16)
+    try:
+        for i in range(100):
+            with tracing.span(f"s{i}"):
+                pass
+        spans = tracing.spans()
+        assert len(spans) == 16
+        assert spans[-1].name == "s99"  # newest kept, oldest dropped
+    finally:
+        tracing.configure(max_spans=200_000)
+
+
+def test_span_error_marks_and_propagates(tracing):
+    with pytest.raises(ValueError):
+        with tracing.span("boom"):
+            raise ValueError("x")
+    (s,) = tracing.spans()
+    assert "ValueError" in s.args["error"]
+
+
+def test_finish_is_first_finish_wins(tracing):
+    """Overlapping error paths may finish a span twice; the second
+    call must neither duplicate the ring entry nor rewrite the
+    already-recorded outcome."""
+    s = tracing.start_span("once")
+    tracing.finish(s, outcome="resolved")
+    tracing.finish(s, outcome="error")
+    spans = tracing.spans()
+    assert len(spans) == 1
+    assert spans[0].args["outcome"] == "resolved"
+
+
+def test_head_sampling_every_nth_root(tracing):
+    """sample_every=n admits every nth trace HEAD; children of a
+    sampled-in head are never dropped, so admitted traces stay
+    complete."""
+    tracing.configure(sample_every=4)
+    try:
+        roots = []
+        for i in range(16):
+            with tracing.span(f"head{i}") as s:
+                if s is not None:
+                    roots.append(i)
+                    with tracing.span("kid") as kid:
+                        assert kid is not None  # child never sampled out
+        assert len(roots) == 4  # 16 heads / 4
+        # sampled-out heads created no spans at all
+        spans = tracing.spans()
+        assert len(spans) == 8  # 4 heads + 4 kids
+        assert all(
+            s.name == "kid" or int(s.name[4:]) in roots for s in spans
+        )
+    finally:
+        tracing.configure(sample_every=1)
+
+
+def test_sampled_out_head_suppresses_nested_heads(tracing):
+    """A dropped head must poison its scope: a parentless span nested
+    inside it (serve.op → serve.request on the protocol path) is
+    suppressed outright and does NOT tick the sampler — otherwise the
+    effective rate doubles and half the traces lose their envelope."""
+    tracing.configure(sample_every=2)
+    try:
+        admitted = []
+        for i in range(8):
+            with tracing.span(f"outer{i}") as outer:
+                # cross-thread form, as submit_topk uses it
+                inner = tracing.start_span("inner")
+                if outer is not None:
+                    admitted.append(i)
+                    assert inner is not None  # sampled-in: complete
+                    assert inner.trace_id == outer.trace_id
+                else:
+                    assert inner is None  # dropped head: nothing below
+                tracing.finish(inner)
+        assert len(admitted) == 4  # exactly 1-in-2, not 2-in-2
+        assert len(tracing.spans()) == 8  # 4 outer + 4 inner
+    finally:
+        tracing.configure(sample_every=1)
+
+
+def test_sampling_rejects_bad_rate(tracing):
+    with pytest.raises(ValueError):
+        tracing.configure(sample_every=0)
+
+
+def test_child_span_noops_without_parent(tracing):
+    """child_span is for mid-pipeline segments: under a live parent it
+    nests normally; with no current span it creates nothing (the
+    sampled-out path must not leak orphan roots)."""
+    with tracing.child_span("orphan") as s:
+        assert s is None
+    with tracing.span("root"):
+        with tracing.child_span("kid") as kid:
+            assert kid is not None
+    assert {s.name for s in tracing.spans()} == {"root", "kid"}
+
+
+def test_serving_sampled_tracing_no_orphans(hin, metapath, tracing):
+    """Under head sampling, an unsampled serving request creates ZERO
+    spans anywhere in the pipeline — every span in the ring still
+    belongs to a sampled-in serve.request trace (or is a batch span
+    parented into one), and sampled-in traces resolve with outcomes."""
+    svc = _service(hin, metapath, "numpy", max_batch=4,
+                   cache_entries=0, tile_cache_bytes=0)
+    # sampling on AFTER the build: the backend.init span is not part of
+    # this test's request accounting
+    tracing.clear()
+    tracing.configure(sample_every=4)
+    try:
+        for r in range(32):
+            svc.topk_index(int(r % svc.n), k=3)
+    finally:
+        svc.close()
+        tracing.configure(sample_every=1)
+    spans = tracing.spans()
+    roots = [s for s in spans if s.name == "serve.request"]
+    assert len(roots) == 8  # 32 admissions / 4
+    assert all("outcome" in s.args for s in roots)
+    # no orphans: every span's parent chain ends at a serve.request
+    by_id = {s.span_id: s for s in spans}
+    for s in spans:
+        top = s
+        while top.parent_id is not None:
+            assert top.parent_id in by_id, (s.name, top.name)
+            top = by_id[top.parent_id]
+        assert top.name == "serve.request", (s.name, top.name)
+
+
+def test_disabled_tracer_yields_none_and_records_nothing():
+    tracer = get_tracer()
+    assert not tracer.enabled
+    with tracer.span("ghost") as s:
+        assert s is None
+    tracer.finish(None)  # must be a no-op, not a crash
+    assert tracer.spans() == []
+
+
+def test_chrome_trace_export(tracing, tmp_path):
+    with tracing.span("parent", detail=1):
+        with tracing.span("kid"):
+            pass
+    path = tmp_path / "trace.json"
+    n = obs.write_chrome_trace(str(path))
+    assert n == 2
+    doc = json.loads(path.read_text())
+    events = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert {e["name"] for e in events} == {"parent", "kid"}
+    for e in events:
+        assert e["dur"] >= 0 and e["ts"] > 0
+        assert {"trace_id", "span_id"} <= e["args"].keys()
+    # thread-name metadata present for every tid that emitted spans
+    meta = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    assert {e["tid"] for e in meta} >= {e["tid"] for e in events}
+
+
+# -- serving integration: one request = one connected trace ---------------
+
+
+def test_serving_trace_connected_across_thread_hop(hin, metapath, tracing):
+    """Concurrent submitters, coalesced batches: every span's parent
+    resolves inside its own trace, and at least one dispatched request
+    carries the full enqueue→dispatch→device→complete chain."""
+    svc = _service(hin, metapath, "numpy", max_batch=4,
+                   cache_entries=0, tile_cache_bytes=0)
+    errs: list[BaseException] = []
+    try:
+        def client(rows):
+            try:
+                for r in rows:
+                    svc.topk_index(int(r), k=5)
+            except BaseException as exc:  # pragma: no cover
+                errs.append(exc)
+
+        rng = np.random.default_rng(11)
+        threads = [
+            threading.Thread(target=client,
+                             args=(rng.integers(0, svc.n, 12),))
+            for _ in range(6)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        svc.close()
+    assert not errs
+    spans = tracing.spans()
+    by_id = {s.span_id: s for s in spans}
+    by_trace: dict[int, list] = {}
+    for s in spans:
+        by_trace.setdefault(s.trace_id, []).append(s)
+    # no dangling or cross-trace parent links anywhere
+    for s in spans:
+        if s.parent_id is not None:
+            assert s.parent_id in by_id, s.name
+            assert by_id[s.parent_id].trace_id == s.trace_id, s.name
+    # every root request span resolved with an outcome
+    roots = [s for s in spans if s.name == "serve.request"]
+    assert len(roots) == 6 * 12
+    assert all("outcome" in s.args for s in roots)
+    # at least one trace carries the full dispatched chain (the batch
+    # head's trace owns dispatch/device/complete; members reach the
+    # shared work through it)
+    chain = {"serve.request", "serve.enqueue", "serve.dispatch",
+             "serve.device_execute", "serve.complete",
+             "serve.host_transfer", "serve.cache_fill"}
+    full = [
+        tid for tid, members in by_trace.items()
+        if chain <= {s.name for s in members}
+    ]
+    assert full, "no dispatched request produced a connected full chain"
+    # and the chain genuinely crossed threads
+    tid = full[0]
+    assert len({s.thread_name for s in by_trace[tid]}) >= 3
+
+
+def test_stage_timer_is_a_span_shim(hin, tracing):
+    """The deprecated StageTimer keeps its API and event while feeding
+    the span tree and the stage histogram."""
+    from distributed_pathsim_tpu.utils.logging import RunLogger
+    from distributed_pathsim_tpu.utils.profiling import StageTimer
+
+    get_registry().reset()
+    buf = io.StringIO()
+    logger = RunLogger(output_path=None, echo=False)
+    logger._metrics = buf
+    timer = StageTimer(logger)
+    with timer.stage("outer_stage"):
+        with timer.stage("inner_stage"):
+            pass
+    assert [name for name, _ in timer.stages] == [
+        "inner_stage", "outer_stage",
+    ]
+    spans = {s.name: s for s in get_tracer().spans()}
+    assert spans["stage:inner_stage"].parent_id \
+        == spans["stage:outer_stage"].span_id
+    events = [json.loads(line) for line in buf.getvalue().splitlines()]
+    assert [e["event"] for e in events] == ["stage_time", "stage_time"]
+    assert all("ts" in e and "ts_mono" in e for e in events)
+    hist = get_registry().histogram("dpathsim_stage_seconds")
+    assert hist.labels(stage="outer_stage").count == 1
+
+
+def test_runtime_event_concurrent_lines_stay_atomic(monkeypatch):
+    """Worker threads emitting concurrently must never interleave
+    stderr characters mid-line (the locked single-write contract)."""
+    import sys as _sys
+
+    from distributed_pathsim_tpu.utils import logging as ulog
+
+    buf = io.StringIO()
+    monkeypatch.setattr(_sys, "stderr", buf)
+    threads = [
+        threading.Thread(
+            target=lambda i=i: [
+                ulog.runtime_event("obs_test", worker=i, seq=j)
+                for j in range(50)
+            ]
+        )
+        for i in range(8)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    lines = buf.getvalue().splitlines()
+    assert len(lines) == 400
+    assert all(
+        re.fullmatch(r"\[pathsim:obs_test\] worker=\d+ seq=\d+", ln)
+        for ln in lines
+    )
+
+
+def test_timestamps_carry_both_clocks():
+    from distributed_pathsim_tpu.utils.logging import timestamps
+
+    a, b = timestamps(), timestamps()
+    assert set(a) == {"ts", "ts_mono"}
+    assert b["ts_mono"] >= a["ts_mono"]  # monotonic never steps back
+    assert a["ts"] > 1e9  # wall clock is epoch-scaled
+
+
+# -- Prometheus export (satellite: well-formedness + atomicity) -----------
+
+_SAMPLE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"  # metric name
+    r"(\{[a-zA-Z_][a-zA-Z0-9_]*=\"([^\"\\]|\\.)*\""  # first label
+    r"(,[a-zA-Z_][a-zA-Z0-9_]*=\"([^\"\\]|\\.)*\")*\})?"  # rest
+    r" (-?\d+(\.\d+)?([eE][+-]?\d+)?|[+-]Inf|NaN)$"
+)
+
+
+def _well_formed(text: str) -> None:
+    assert text.endswith("\n")
+    for line in text.splitlines():
+        if line.startswith("# HELP ") or line.startswith("# TYPE "):
+            continue
+        assert _SAMPLE.match(line), line
+
+
+def test_render_prometheus_well_formed():
+    reg = MetricsRegistry()
+    reg.counter("req_total", "requests").inc(5, op="topk")
+    reg.counter("req_total").inc(2, op="stats")
+    reg.gauge("depth", 'tricky "help"').set(3)
+    h = reg.histogram("lat_seconds", "latency")
+    for v in (1e-8, 1e-4, 3e-4, 0.02, 0.5, 500.0):  # under+mid+overflow
+        h.observe(v)
+    text = obs.render_prometheus(reg)
+    _well_formed(text)
+    assert "# TYPE req_total counter" in text
+    assert "# TYPE lat_seconds histogram" in text
+    assert 'req_total{op="topk"} 5' in text
+    # cumulative buckets: non-decreasing, +Inf equals _count
+    cums = [
+        float(line.rsplit(" ", 1)[1])
+        for line in text.splitlines()
+        if line.startswith("lat_seconds_bucket")
+    ]
+    assert cums == sorted(cums)
+    assert cums[-1] == 6.0  # the +Inf bucket
+    assert "lat_seconds_count 6" in text
+    # underflow folded into the first bound, overflow only in +Inf
+    assert cums[0] >= 1.0
+
+
+def test_textfile_exporter_atomic_and_final_write(tmp_path):
+    reg = MetricsRegistry()
+    c = reg.counter("ticks").labels()
+    path = tmp_path / "metrics.prom"
+    exp = obs.PrometheusTextfileExporter(
+        str(path), interval_s=3600, registry=reg
+    )
+    with exp:
+        assert path.exists()  # first write is synchronous on start()
+        _well_formed(path.read_text())
+        c.inc(41)
+        c.inc()
+    # stop() performed a final write: shutdown state is on disk
+    assert "ticks 42" in path.read_text()
+    # atomicity: no temp droppings beside the target
+    assert list(tmp_path.iterdir()) == [path]
+    exp.stop()  # idempotent
+
+
+def test_label_escaping_round_trip():
+    reg = MetricsRegistry()
+    reg.counter("c").inc(path='we"ird\\lab\nel')
+    text = obs.render_prometheus(reg)
+    _well_formed(text)
+    assert '\\"' in text and "\\\\" in text and "\\n" in text
+
+
+# -- the metrics protocol op (satellite: round-trip + agreement) ----------
+
+
+def test_metrics_protocol_op_round_trip(hin, metapath):
+    from distributed_pathsim_tpu.serving.protocol import (
+        handle_request,
+        serve_loop,
+    )
+
+    get_registry().reset()
+    svc = _service(hin, metapath, "numpy", max_batch=4)
+    try:
+        for row in (5, 9, 5, 5, 9, 23):  # repeats → result-cache hits
+            assert handle_request(
+                svc, {"id": 1, "op": "topk", "row": row, "k": 3}
+            )["ok"]
+        resp = handle_request(svc, {"id": 2, "op": "metrics"})
+        assert resp["ok"]
+        payload = json.loads(json.dumps(resp))["result"]  # JSON-safe
+        ops = payload["ops"]
+        assert ops["topk"]["count"] == 6
+        assert (
+            0 <= ops["topk"]["p50_ms"] <= ops["topk"]["p95_ms"]
+            <= ops["topk"]["p99_ms"]
+        )
+        # cache hit counts agree EXACTLY with the service-level
+        # counters, and with the registry's per-tier cells
+        caches = payload["caches"]
+        assert caches["result"]["hits"] == svc.result_cache.hits == 3
+        assert caches["result"]["misses"] == svc.result_cache.misses == 3
+        assert caches["result"]["hit_rate"] == 0.5
+        reg_hits = (
+            get_registry()
+            .counter("dpathsim_serve_cache_hits_total")
+            .labels(tier="result")
+            .get()
+        )
+        assert reg_hits == svc.result_cache.hits
+        # full registry snapshot rides along for tooling
+        assert "dpathsim_request_seconds" in payload["registry"]
+        assert payload["enabled"]["metrics"] is True
+
+        # and over the wire: one JSONL line in, one line out
+        out = io.StringIO()
+        rc = serve_loop(
+            svc,
+            io.StringIO('{"id": 7, "op": "metrics"}\n'
+                        '{"id": 8, "op": "shutdown"}\n'),
+            out,
+        )
+        assert rc == 0
+        line = json.loads(out.getvalue().splitlines()[0])
+        assert line["ok"] and line["result"]["ops"]["topk"]["count"] == 6
+    finally:
+        svc.close()
+
+
+def test_stats_carries_live_latency_quantiles(hin, metapath):
+    get_registry().reset()
+    svc = _service(hin, metapath, "numpy", max_batch=4)
+    try:
+        for row in (3, 3, 3, 8):
+            svc.topk_index(row, k=4)
+        stats = svc.stats()
+        lat = stats["obs"]["latency"]
+        assert lat["dispatch"]["count"] == 2  # rows 3 and 8, cold
+        assert lat["hit_result"]["count"] == 2  # row 3 repeats
+        for entry in lat.values():
+            assert entry["p50_ms"] <= entry["p99_ms"]
+        assert stats["obs"]["metrics"] is True
+    finally:
+        svc.close()
+
+
+def test_runtime_events_counted_in_registry(tmp_path):
+    from distributed_pathsim_tpu.utils.logging import runtime_event
+
+    get_registry().reset()
+    runtime_event("obs_count_check", echo=False, a=1)
+    runtime_event("obs_count_check", echo=False, a=2)
+    cell = (
+        get_registry()
+        .counter("dpathsim_events_total")
+        .labels(event="obs_count_check")
+    )
+    assert cell.get() == 2
+
+
+# -- telemetry discipline lint (satellite: static analysis, tier-1) -------
+
+
+def test_lint_telemetry():
+    import pathlib
+    import sys
+
+    repo = pathlib.Path(__file__).resolve().parents[1]
+    sys.path.insert(0, str(repo / "scripts"))
+    try:
+        import lint_telemetry
+    finally:
+        sys.path.pop(0)
+    violations = lint_telemetry.scan_package()
+    assert not violations, "\n".join(v.render() for v in violations)
+
+
+# -- obs smoke (satellite: CI gate, non-slow) -----------------------------
+
+
+def test_bench_obs_smoke(tmp_path):
+    """``make obs-smoke`` in-process: zero additional steady-state XLA
+    compiles under every obs arm, connected traces in both tracing
+    arms, head sampling genuinely suppressing span creation, and
+    absolute added cost per fully-traced request under 1 ms — all arms
+    interleaved against the obs-off baseline."""
+    import pathlib
+    import sys
+
+    repo = str(pathlib.Path(__file__).resolve().parents[1])
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    import bench_serving
+
+    result = bench_serving.run_obs_smoke(str(tmp_path / "obs.json"))
+    assert all(result["smoke_checks"].values()), result["smoke_checks"]
+    audit = result["arms"]["traced"]["trace_audit"]
+    assert audit["broken_parent_links"] == 0
+    assert audit["unlinked_request_traces"] == 0
+    assert (tmp_path / "obs.json").exists()
