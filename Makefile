@@ -3,7 +3,8 @@
 
 PYTHON ?= python
 
-.PHONY: test chaos serve-smoke update-smoke obs-smoke lint-telemetry
+.PHONY: test chaos serve-smoke update-smoke obs-smoke lint-telemetry \
+	tune-smoke lint-tuning tune
 
 # Tier-1: the fast CPU suite (the driver's acceptance gate).
 test:
@@ -50,3 +51,25 @@ obs-smoke:
 # (tests/test_obs.py::test_lint_telemetry), so tier-1 covers it.
 lint-telemetry:
 	$(PYTHON) scripts/lint_telemetry.py
+
+# Tuning smoke: measure a tiny real dispatch table, serve under it,
+# and gate the three contracts — table hit path exercised, corrupt/
+# fingerprint-mismatched tables degrade to heuristics (never a crash),
+# zero steady-state XLA compiles under tuned serving. Also a non-slow
+# pytest (tests/test_tuning.py::test_tune_smoke), so tier-1 covers it.
+tune-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/tune_sweep.py --smoke
+
+# Tuning discipline: new hardcoded tile/bucket constants outside
+# tuning/registry.py are rejected (that's how the pre-tuning
+# heuristics fossilized). Also a non-slow pytest
+# (tests/test_tuning.py::test_lint_tuning), so tier-1 covers it.
+lint-tuning:
+	$(PYTHON) scripts/lint_tuning.py
+
+# Offline autotune of THIS machine (CPU by default; run on the TPU
+# host — bench.py tunnel protocol — for the chip's table):
+#   dpathsim --tuning-table artifacts/tuning_table_cpu.json ...
+tune:
+	JAX_PLATFORMS=cpu $(PYTHON) -m distributed_pathsim_tpu.cli tune \
+		--out artifacts/tuning_table_cpu.json

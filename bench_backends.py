@@ -46,6 +46,11 @@ def parse_args(argv=None):
     p.add_argument("--out", default=None,
                    help="also append the JSON lines to this file")
     p.add_argument(
+        "--ring-interpret", action="store_true",
+        help="off-TPU, also time the rect-Pallas ring-step arm in "
+        "interpret mode (host-cost bound only; labeled as such)",
+    )
+    p.add_argument(
         "--platform",
         default="cpu",
         choices=("cpu", "tpu"),
@@ -93,9 +98,13 @@ def _ensure_devices(n: int) -> str:
     return "cpu"
 
 
-def bench_backend(name: str, hin, mp, k: int, repeats: int, n_devices: int):
+def bench_backend(name: str, hin, mp, k: int, repeats: int, n_devices: int,
+                  ring_interpret: bool = False):
     """Median-of-``repeats`` wall-clock (with min/max spread) for a full
-    rank-all top-k, including the host fetch of the [N, k] winners."""
+    rank-all top-k, including the host fetch of the [N, k] winners.
+    For the jax-sharded tier also returns per-ring-step timings of the
+    two fold kernels (rect-Pallas vs jnp) — the CPU-runnable half of
+    the sharded tier's kernel story (VERDICT r05 #6)."""
     import statistics
 
     from distributed_pathsim_tpu.backends.base import create_backend
@@ -116,7 +125,64 @@ def bench_backend(name: str, hin, mp, k: int, repeats: int, n_devices: int):
         t0 = time.perf_counter()
         run()
         times.append(time.perf_counter() - t0)
-    return statistics.median(times), min(times), max(times)
+    ring = (
+        bench_ring_step(backend, k, repeats, interpret_ok=ring_interpret)
+        if name == "jax-sharded" else None
+    )
+    return statistics.median(times), min(times), max(times), ring
+
+
+def bench_ring_step(backend, k: int, repeats: int,
+                    interpret_ok: bool = False) -> dict:
+    """One ``sharded_ring_step`` per fold kernel, interleaved
+    (utils/benchrunner.py): the per-step number that bounds the
+    multi-chip ring story. The rect-Pallas arm runs compiled on a real
+    TPU; elsewhere it is interpret-mode and only measured when
+    ``interpret_ok`` (an interpret timing is honest about the fold's
+    host cost but says nothing about the chip — the label carries the
+    mode so nobody misreads it)."""
+    import jax
+    import numpy as np
+
+    from distributed_pathsim_tpu.ops import pallas_kernels as pk
+    from distributed_pathsim_tpu.parallel.sharded import (
+        sharded_ring_state,
+        sharded_ring_step,
+    )
+    from distributed_pathsim_tpu.utils import benchrunner as br
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = backend.mesh
+    c, d = sharded_ring_state(backend._first, (), mesh=mesh)
+    n_pad = int(c.shape[0])
+    sharding2 = NamedSharding(mesh, P("dp", None))
+    best_v = jax.device_put(
+        np.full((n_pad, k), -np.inf, dtype=np.float32), sharding2
+    )
+    best_i = jax.device_put(np.zeros((n_pad, k), dtype=np.int32), sharding2)
+
+    def arm(use_pallas: bool):
+        def run():
+            jax.block_until_ready(
+                sharded_ring_step(
+                    c, d, c, d, best_v, best_i, 0,
+                    mesh=mesh, k=k, n_true=backend.n,
+                    use_pallas=use_pallas,
+                )
+            )
+
+        return run
+
+    arms = {"jnp_fold": arm(False)}
+    pallas_real = pk.pallas_supported()
+    if pk.rect_supported(int(c.shape[1]), k) and (pallas_real or interpret_ok):
+        label = "rect_pallas" if pallas_real else "rect_pallas_interpret"
+        arms[label] = arm(True)
+    res = br.time_interleaved(arms, repeats)
+    return {
+        name: {k2: v for k2, v in r.items() if k2 != "times_ms"}
+        for name, r in res.items()
+    }
 
 
 def main(argv=None) -> None:
@@ -147,32 +213,33 @@ def main(argv=None) -> None:
     pairs = float(args.authors) * (args.authors - 1)
 
     for name in [b.strip() for b in args.backends.split(",") if b.strip()]:
-        med, tmin, tmax = bench_backend(
+        med, tmin, tmax, ring = bench_backend(
             name, hin, mp, k=args.top_k, repeats=args.repeats,
-            n_devices=args.devices,
+            n_devices=args.devices, ring_interpret=args.ring_interpret,
         )
         scale = f"{args.authors // 1000}k" if args.authors >= 1000 else str(args.authors)
         # Only the sharded tier actually spans the mesh; labeling the
         # single-device tiers with the mesh size would misread as a
         # multi-device result.
         n_dev = args.devices if name == "jax-sharded" else 1
-        line = json.dumps(
-            {
-                "metric": (
-                    f"author_pairs_per_sec_{name}_{scale}_authors_"
-                    f"top{args.top_k}_{platform}{n_dev}dev"
-                ),
-                # min-of-reps, same rationale as bench.py: robust to
-                # external load on a shared box; spread stays visible
-                "value": pairs / tmin,
-                "unit": "pairs/sec",
-                "vs_baseline": None,  # CPU mesh: no honest TPU ratio
-                "seconds_min": tmin,
-                "seconds_median": med,
-                "seconds_max": tmax,
-                "reps": args.repeats,
-            }
-        )
+        record = {
+            "metric": (
+                f"author_pairs_per_sec_{name}_{scale}_authors_"
+                f"top{args.top_k}_{platform}{n_dev}dev"
+            ),
+            # min-of-reps, same rationale as bench.py: robust to
+            # external load on a shared box; spread stays visible
+            "value": pairs / tmin,
+            "unit": "pairs/sec",
+            "vs_baseline": None,  # CPU mesh: no honest TPU ratio
+            "seconds_min": tmin,
+            "seconds_median": med,
+            "seconds_max": tmax,
+            "reps": args.repeats,
+        }
+        if ring is not None:
+            record["ring_step_ms"] = ring
+        line = json.dumps(record)
         print(line, flush=True)
         if args.out:
             with open(args.out, "a", encoding="utf-8") as f:

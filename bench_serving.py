@@ -506,6 +506,8 @@ def run_obs_bench(
         rng.integers(0, n, size=(clients, queries_per_client)),
     ).tolist()
 
+    from distributed_pathsim_tpu.utils import benchrunner as br
+
     ARMS = {
         "off": dict(metrics=False, tracing=False, trace_sample=1),
         "metrics": dict(metrics=True, tracing=False, trace_sample=1),
@@ -533,18 +535,22 @@ def run_obs_bench(
             )
         return res
 
-    runs: dict[str, list[dict]] = {name: [] for name in ARMS}
     try:
-        for _ in range(reps):
-            for name, cfg in ARMS.items():
-                runs[name].append(one_arm(cfg))
+        # interleaved arms via the shared estimator (benchrunner):
+        # round r runs every arm once, so machine drift hits all arms
+        # equally — the BENCH_OBS_r08 discipline, now at one site
+        runs = br.interleave(
+            {name: (lambda cfg=cfg: one_arm(cfg)) for name, cfg in
+             ARMS.items()},
+            reps,
+        )
     finally:
         # restore process defaults (metrics on, tracing off) — later
         # code in this process must not inherit a bench arm's switches
         obs.configure(metrics=True, tracing=False, trace_sample=1)
         obs.get_tracer().clear()
 
-    med = lambda xs: sorted(xs)[len(xs) // 2]
+    med = br.median
     arms_out: dict[str, dict] = {}
     qps_off = med([a["qps"] for a in runs["off"]])
     # Best-window estimator alongside the median: on a shared box,
@@ -591,7 +597,9 @@ def run_obs_bench(
             "multi-tenant box: baseline drifts up to 3x between reps, "
             "so medians bound drift, qps_best/added_us_per_request_best "
             "(fastest window per arm) is the dedicated-machine estimate; "
-            "compile counts and trace audits are deterministic"
+            "compile counts and trace audits are deterministic. Arm "
+            "interleaving + estimators come from utils/benchrunner.py "
+            "(shared with scripts/kernel_bench.py and dpathsim tune)"
         ),
     }
 

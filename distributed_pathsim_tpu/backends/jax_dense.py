@@ -294,12 +294,29 @@ class JaxDenseBackend(PathSimBackend):
             return _diag_from_half(c)
         raise ValueError(f"unknown PathSim variant {variant!r}")
 
+    def _scores_variant(self, n: int, v: int) -> str:
+        """Pallas-vs-XLA choice for the dense all-pairs scores — the
+        KERNELS_r05 finding as a tuned knob (the fused Pallas kernel
+        wins at 8k, XLA's own fusion at 32k). Untuned default keeps the
+        pre-tuning behavior: Pallas whenever it is available."""
+        from .. import tuning
+
+        return tuning.choose(
+            "scores_variant", n=n, v=v,
+            dtype=str(np.dtype(self.dtype)), default="pallas",
+        )
+
     def all_pairs_scores(self, variant: str = "rowsum") -> np.ndarray:
         if not self._symmetric:
             return super().all_pairs_scores(variant)
         c, rowsums = self._half()
         d = self._denominator_device(c, rowsums, variant)
-        if self.use_pallas:
+        use_pallas = (
+            self.use_pallas
+            and self._scores_variant(int(c.shape[0]), int(c.shape[1]))
+            == "pallas"
+        )
+        if use_pallas:
             if pk.fits_vmem(c.shape[1]):
                 scores = pk.fused_scores(c, d)
             else:

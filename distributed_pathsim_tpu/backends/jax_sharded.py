@@ -208,7 +208,10 @@ class JaxShardedBackend(PathSimBackend):
              variant: str = "rowsum"):
         """Distributed per-row top-k via the ppermute ring: no device
         ever holds more than an [n_loc, n_loc] score tile, and only
-        [N, k] winners come back to the host."""
+        [N, k] winners come back to the host. The ring-step kernel
+        (rect-Pallas vs jnp fold) is resolved HERE, outside
+        sharded_topk's jit cache, so a tuning table installed after a
+        prior trace still takes effect."""
         vals, idxs = sharded_topk(
             self._first,
             (),
@@ -217,6 +220,7 @@ class JaxShardedBackend(PathSimBackend):
             n_true=self.n,
             mask_self=mask_self,
             variant=variant,
+            use_pallas=self._use_ring_pallas(k),
         )
         return (
             _fetch(vals).astype(np.float64)[: self.n],
@@ -224,11 +228,9 @@ class JaxShardedBackend(PathSimBackend):
         )
 
     def _use_ring_pallas(self, k: int) -> bool:
-        from ..ops import pallas_kernels as pk
+        from ..parallel.sharded import resolve_ring_kernel
 
-        return pk.pallas_supported() and pk.rect_supported(
-            self._coo_shape[1], k
-        )
+        return resolve_ring_kernel(self.n, self._coo_shape[1], k)
 
     def _ring_run_config(self, k: int, variant: str,
                          use_pallas: bool) -> dict:

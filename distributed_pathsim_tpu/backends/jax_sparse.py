@@ -37,7 +37,7 @@ class JaxSparseBackend(PathSimBackend):
         self,
         hin,
         metapath: MetaPath,
-        tile_rows: int = 4096,
+        tile_rows: int | None = None,
         dtype=jnp.float32,
         exact_counts: bool = True,
         dense_c_budget_bytes: int | None = None,
@@ -65,14 +65,45 @@ class JaxSparseBackend(PathSimBackend):
             raise ValueError("jax-sparse requires a symmetric metapath")
         self.exact_counts = exact_counts
         self._dtype = dtype
-        self._tile_rows_req = tile_rows
         self._dense_c_budget = (
             self._DENSE_C_DEVICE_BUDGET
             if dense_c_budget_bytes is None
             else int(dense_c_budget_bytes)
         )
         self._rect_kernel = rect_kernel
-        self._bind_factor(sp.half_chain_coo(hin, metapath))
+        coo = sp.half_chain_coo(hin, metapath)
+        from .. import tuning
+
+        if tile_rows is None:
+            # tuned streaming tile width, keyed on the folded factor's
+            # real (N, V, density). Resolved ONCE here and pinned: a
+            # delta rebind reuses it, so tile program shapes stay
+            # stable across updates (the recompile-free contract).
+            tile_rows = int(
+                tuning.choose(
+                    "sparse_tile_rows",
+                    n=coo.shape[0], v=coo.shape[1],
+                    nnz=int(coo.rows.shape[0]),
+                    dtype=str(np.dtype(dtype)),
+                    default=4096,
+                )
+            )
+        self._tile_rows_req = tile_rows
+        # the scatter-pad floor is pinned at build for the same reason:
+        # a delta rebind that re-consulted the table with its drifted
+        # nnz (or a density that crossed a decade bucket) could flip
+        # the compiled scatter's pad shape mid-serve — exactly the
+        # steady-state recompile the floor exists to prevent
+        self._nnz_floor_req = int(
+            tuning.choose(
+                "sparse_nnz_floor",
+                n=coo.shape[0], v=coo.shape[1],
+                nnz=int(coo.rows.shape[0]),
+                dtype=str(np.dtype(dtype)),
+                default=1,
+            )
+        )
+        self._bind_factor(coo)
 
     def _bind_factor(self, coo) -> None:
         """Bind a (new) half-chain factor: overflow-mode detection,
@@ -118,6 +149,7 @@ class JaxSparseBackend(PathSimBackend):
             # exactly the recompile the capacity invariant exists to
             # prevent. coo.shape[0] is delta-stable by construction.
             tile_rows=min(self._tile_rows_req, max(coo.shape[0], 8)),
+            nnz_bucket_floor=self._nnz_floor_req,
             dtype=dtype,
             # in rescore mode the f32 tiles are a prefilter by design;
             # the tiled guard would refuse what the rescore phase fixes

@@ -126,6 +126,20 @@ def build_parser() -> argparse.ArgumentParser:
         "checkpointed ring step)",
     )
     p.add_argument(
+        "--tuning-table",
+        default=None,
+        help="measured dispatch table from `dpathsim tune` (JSON); "
+        "absent/corrupt/version-mismatched tables degrade to the "
+        "built-in heuristics with a tuning_fallback event. Default: "
+        "the PATHSIM_TUNING_TABLE env var when set",
+    )
+    p.add_argument(
+        "--no-tuning",
+        action="store_true",
+        help="ignore any tuning table (env included): every kernel/"
+        "tile/bucket knob uses its built-in heuristic",
+    )
+    p.add_argument(
         "--max-retries",
         type=int,
         default=None,
@@ -173,6 +187,18 @@ def main(argv: list[str] | None = None) -> int:
 
         try:
             return serve_main(argv[1:])
+        except (KeyError, ValueError, FileNotFoundError) as exc:
+            msg = exc.args[0] if exc.args else exc
+            print(f"error: {msg}", file=sys.stderr)
+            return 1
+    if argv and argv[0] == "tune":
+        # ``dpathsim tune`` — offline autotuner: measure every knob's
+        # candidate arms on THIS device and write the dispatch table
+        # that --tuning-table / PATHSIM_TUNING_TABLE consume.
+        from .tuning.autotuner import tune_main
+
+        try:
+            return tune_main(argv[1:])
         except (KeyError, ValueError, FileNotFoundError) as exc:
             msg = exc.args[0] if exc.args else exc
             print(f"error: {msg}", file=sys.stderr)
@@ -369,6 +395,8 @@ def _run(args) -> int:
         echo=not args.quiet,
         max_retries=args.max_retries,
         degrade=not args.no_degrade,
+        tuning_table=args.tuning_table,
+        tuning=not args.no_tuning,
     )
 
     from . import obs
@@ -476,6 +504,10 @@ def _run_multipath(args) -> int:
         "--tile-rows": args.tile_rows is not None,
         "--approx": args.approx,
         "--headroom": args.headroom != 0.0,
+        # the batched scorer has no tuned knobs — refuse rather than
+        # silently ignore a table the user thinks is active
+        "--tuning-table": args.tuning_table is not None,
+        "--no-tuning": args.no_tuning,
         # no backend chain to step down in this mode — refuse rather
         # than silently ignore
         "--no-degrade": args.no_degrade,

@@ -39,3 +39,8 @@ class RunConfig:
     # failures fatal instead of stepping down the chain.
     max_retries: int | None = None
     degrade: bool = True
+    # Measured-dispatch knobs (tuning/): path of a ``dpathsim tune``
+    # table (None = honor PATHSIM_TUNING_TABLE, else built-in
+    # heuristics); tuning=False pins every knob to its heuristic.
+    tuning_table: str | None = None
+    tuning: bool = True

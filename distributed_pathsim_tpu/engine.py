@@ -134,6 +134,19 @@ def build_backend(
     from .utils.xla_flags import install_compile_metrics
 
     install_compile_metrics()
+    # Tuning dispatch next, BEFORE any tile/variant decision is made
+    # (backend init consults it): explicit --tuning-table, else the
+    # PATHSIM_TUNING_TABLE deploy default, else heuristics. An unusable
+    # table degrades to heuristics with one tuning_fallback event — it
+    # never fails the bootstrap.
+    from . import tuning
+
+    tuning.set_enabled(config.tuning)
+    if config.tuning:
+        if config.tuning_table:
+            tuning.install_table(config.tuning_table)
+        else:
+            tuning.install_from_env()
     if config.loader not in USE_NATIVE_BY_LOADER:
         raise ValueError(
             f"unknown loader {config.loader!r}; "

@@ -39,19 +39,49 @@ def _ceil_to(x: int, k: int) -> int:
     return ((x + k - 1) // k) * k
 
 
-def _default_scores_tiles(n: int, v: int) -> tuple[int, int]:
-    """fused_scores' default output tile. The on-chip sweep
+def tile_fits_vmem(bm: int, bn: int, v: int) -> bool:
+    """Whether an output tile (bm, bn) at contraction width ``v`` fits
+    the single-pass kernels' VMEM budget (two [tile, v_pad] C blocks +
+    the out tile). The feasibility gate every tile choice — heuristic
+    or tuned — must pass."""
+    v_pad = _ceil_to(max(v, 128), 128)
+    return (bm + bn) * v_pad * 4 + bm * bn * 4 <= _VMEM_BUDGET_BYTES
+
+
+def _heuristic_scores_tiles(n: int, v: int) -> tuple[int, int]:
+    """fused_scores' built-in tile heuristic. The on-chip sweep
     (KERNELS_r05.json, v5e, V=384): (256, 512) reaches 90.3% of the
     f32 MXU ceiling at N=8k (XLA's GEMM: 86.7%), (512, 1024) 85.3% at
     N=32k (XLA: 87.0%), vs 74–80% for the old (256, 256) default.
     Wider tiles hold bigger [tile, v_pad] C blocks, so the pick must
     honor the same VMEM budget fits_vmem() polices — at wide V the
     sweep winners would not fit and the floor config stays."""
-    v_pad = _ceil_to(max(v, 128), 128)
     for bm, bn in ((256, 512),) if n <= 16384 else ((512, 1024), (256, 512)):
-        if (bm + bn) * v_pad * 4 + bm * bn * 4 <= _VMEM_BUDGET_BYTES:
+        if tile_fits_vmem(bm, bn, v):
             return bm, bn
     return _BM, _BN
+
+
+def _default_scores_tiles(n: int, v: int) -> tuple[int, int]:
+    """Resolved output tile: the dispatch table's measured choice for
+    this (device, shape) key when one is installed, the heuristic
+    otherwise — and the heuristic again if a tuned choice no longer
+    passes the VMEM gate (a table must never push a kernel over a
+    hardware budget)."""
+    from .. import tuning
+
+    bm, bn = tuning.choose(
+        "scores_tile", n=n, v=v,
+        default=lambda: _heuristic_scores_tiles(n, v),
+    )
+    # sanitize BEFORE the budget check: Mosaic needs sublane-aligned
+    # rows and lane-aligned columns, and a hand-built table entry must
+    # cost performance at worst, never a lowering failure
+    bm = max(8, _ceil_to(int(bm), 8))
+    bn = max(128, _ceil_to(int(bn), 128))
+    if not tile_fits_vmem(bm, bn, v):
+        return _heuristic_scores_tiles(n, v)
+    return bm, bn
 
 
 def _tile_dot(c_i_ref, c_j_ref):
@@ -91,9 +121,6 @@ def _scores_kernel(c_i_ref, c_j_ref, d_i_ref, d_j_ref, out_ref):
     out_ref[:] = _normalize(_tile_dot(c_i_ref, c_j_ref), d_i_ref, d_j_ref)
 
 
-@functools.partial(
-    jax.jit, static_argnames=("interpret", "bm", "bn")
-)
 def fused_scores(c: jax.Array, rowsums: jax.Array, interpret: bool = False,
                  bm: int | None = None, bn: int | None = None):
     """All-pairs PathSim scores from the half-chain factor, fused.
@@ -106,14 +133,26 @@ def fused_scores(c: jax.Array, rowsums: jax.Array, interpret: bool = False,
     intensity per HBM byte grows ∝ tile edge, so larger tiles close the
     gap to XLA's GEMM — but every config must be validated ON CHIP
     (scripts/kernel_bench.py --sweep-tiles; Mosaic VMEM/layout limits
-    don't reproduce in interpret mode).
+    don't reproduce in interpret mode). With no override the tile comes
+    from the tuning dispatch (_default_scores_tiles) — resolved HERE,
+    outside the jitted core, so a table installed mid-process is never
+    frozen into a cached trace.
     """
     n, v = c.shape
     if bm is None and bn is None:
-        bm, bn = _default_scores_tiles(n, v)
+        bm, bn = _default_scores_tiles(int(n), int(v))
     else:
         bm = _BM if bm is None else bm
         bn = _BN if bn is None else bn
+    return _fused_scores_jit(c, rowsums, interpret, bm, bn)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("interpret", "bm", "bn")
+)
+def _fused_scores_jit(c: jax.Array, rowsums: jax.Array, interpret: bool,
+                      bm: int, bn: int):
+    n, v = c.shape
     # pad to a multiple of BOTH tile dims: the grid floor-divides by
     # each, and a pad that only covers the larger one would leave
     # output tiles unwritten for non-dividing (bm, bn) pairs
@@ -203,26 +242,54 @@ def _fold_tile_topk(k: int, s, cols, vals_ref, idxs_ref):
     idxs_ref[:] = new_i
 
 
-@functools.partial(jax.jit, static_argnames=("k", "mask_self", "interpret"))
 def fused_topk(
     c: jax.Array,
     rowsums: jax.Array,
     k: int = 10,
     mask_self: bool = True,
     interpret: bool = False,
+    bm: int | None = None,
 ):
     """Per-row top-k scores without materializing the score matrix.
 
     Returns (values [N, k] f32, indices [N, k] int32).
+
+    ``bm`` overrides the row tile (rows folded per grid step); default
+    is the tuning dispatch's ``topk_rowtile`` choice for this shape,
+    resolved outside the jitted core (same staleness argument as
+    :func:`fused_scores`).
     """
     n, v = c.shape
-    n_pad = _ceil_to(max(n, 8), _BM)
+    if bm is None:
+        from .. import tuning
+
+        bm = int(tuning.choose("topk_rowtile", n=int(n), v=int(v),
+                               default=_BM))
+        # same hardware gates as _default_scores_tiles: sublane
+        # alignment, then the VMEM budget for the [bm, v_pad] row block
+        # next to the [_BN, v_pad] column block — a tuned row tile must
+        # cost performance at worst, never a Mosaic failure
+        bm = max(8, _ceil_to(bm, 8))
+        if not tile_fits_vmem(bm, _BN, int(v)):
+            bm = _BM
+    return _fused_topk_jit(c, rowsums, k, mask_self, interpret, bm)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("k", "mask_self", "interpret", "bm")
+)
+def _fused_topk_jit(c: jax.Array, rowsums: jax.Array, k: int,
+                    mask_self: bool, interpret: bool, bm: int):
+    import math
+
+    n, v = c.shape
+    n_pad = _ceil_to(max(n, 8), math.lcm(bm, _BN))
     v_pad = _ceil_to(max(v, 128), 128)
     k_pad = _ceil_to(k, 128)  # lane-aligned output minor dim
     c_p = jnp.zeros((n_pad, v_pad), dtype=jnp.float32).at[:n, :v].set(c)
     d_p = jnp.zeros((n_pad, 1), dtype=jnp.float32).at[:n, 0].set(rowsums)
 
-    grid = (n_pad // _BM, n_pad // _BN)
+    grid = (n_pad // bm, n_pad // _BN)
     vals, idxs = pl.pallas_call(
         functools.partial(_topk_kernel, k, mask_self, n),
         out_shape=(
@@ -231,14 +298,14 @@ def fused_topk(
         ),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((_BM, v_pad), lambda i, j: (i, 0)),
+            pl.BlockSpec((bm, v_pad), lambda i, j: (i, 0)),
             pl.BlockSpec((_BN, v_pad), lambda i, j: (j, 0)),
-            pl.BlockSpec((_BM, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((bm, 1), lambda i, j: (i, 0)),
             pl.BlockSpec((_BN, 1), lambda i, j: (j, 0)),
         ],
         out_specs=(
-            pl.BlockSpec((_BM, k_pad), lambda i, j: (i, 0)),
-            pl.BlockSpec((_BM, k_pad), lambda i, j: (i, 0)),
+            pl.BlockSpec((bm, k_pad), lambda i, j: (i, 0)),
+            pl.BlockSpec((bm, k_pad), lambda i, j: (i, 0)),
         ),
         interpret=interpret,
     )(c_p, c_p, d_p, d_p)
@@ -268,13 +335,31 @@ def _scores_kernel_kt(n_kb, c_i_ref, c_j_ref, d_i_ref, d_j_ref, out_ref,
         out_ref[:] = _normalize(acc_ref[:], d_i_ref, d_j_ref)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+def _default_k_tile(n: int, v: int) -> int:
+    """Contraction tile of the K-tiled variants: the tuning dispatch's
+    choice (lane-aligned, clamped to the padded width) or the _BK
+    heuristic. Resolved outside the jitted cores."""
+    from .. import tuning
+
+    bk = int(tuning.choose("k_tile", n=n, v=v, default=_BK))
+    return max(128, _ceil_to(bk, 128))
+
+
 def fused_scores_ktiled(c: jax.Array, rowsums: jax.Array,
-                        interpret: bool = False):
+                        interpret: bool = False, bk: int | None = None):
     """fused_scores for contraction widths that exceed one VMEM tile."""
     n, v = c.shape
+    if bk is None:
+        bk = _default_k_tile(int(n), int(v))
+    return _fused_scores_ktiled_jit(c, rowsums, interpret, bk)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "bk"))
+def _fused_scores_ktiled_jit(c: jax.Array, rowsums: jax.Array,
+                             interpret: bool, bk: int):
+    n, v = c.shape
     n_pad = _ceil_to(max(n, 8), _BM)
-    bk = min(_BK, _ceil_to(max(v, 128), 128))
+    bk = min(bk, _ceil_to(max(v, 128), 128))
     v_pad = _ceil_to(max(v, 128), bk)
     n_kb = v_pad // bk
     c_p = jnp.zeros((n_pad, v_pad), dtype=jnp.float32).at[:n, :v].set(c)
@@ -325,18 +410,35 @@ def _topk_kernel_kt(k, mask_self, n_true, n_kb, c_i_ref, c_j_ref,
         _fold_tile_topk(k, s, cols, vals_ref, idxs_ref)
 
 
-@functools.partial(jax.jit, static_argnames=("k", "mask_self", "interpret"))
 def fused_topk_ktiled(
     c: jax.Array,
     rowsums: jax.Array,
     k: int = 10,
     mask_self: bool = True,
     interpret: bool = False,
+    bk: int | None = None,
 ):
     """fused_topk for contraction widths that exceed one VMEM tile."""
     n, v = c.shape
+    if bk is None:
+        bk = _default_k_tile(int(n), int(v))
+    return _fused_topk_ktiled_jit(c, rowsums, k, mask_self, interpret, bk)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("k", "mask_self", "interpret", "bk")
+)
+def _fused_topk_ktiled_jit(
+    c: jax.Array,
+    rowsums: jax.Array,
+    k: int,
+    mask_self: bool,
+    interpret: bool,
+    bk: int,
+):
+    n, v = c.shape
     n_pad = _ceil_to(max(n, 8), _BM)
-    bk = min(_BK, _ceil_to(max(v, 128), 128))
+    bk = min(bk, _ceil_to(max(v, 128), 128))
     v_pad = _ceil_to(max(v, 128), bk)
     n_kb = v_pad // bk
     k_pad = _ceil_to(k, 128)

@@ -507,6 +507,7 @@ class TiledHalfChain:
         dtype=jnp.float32,
         max_cached_tiles: int | None = None,
         exact_counts: bool = True,
+        nnz_bucket_floor: int | None = None,
     ):
         self.n, self.v = c.shape
         self.tile_rows = int(tile_rows)
@@ -528,8 +529,24 @@ class TiledHalfChain:
         # delta that nudges the densest tile's nnz would otherwise
         # recompile the scatter on every update. Pow-of-two buckets mean
         # steady-state deltas reuse the compiled program; the extra pad
-        # entries carry weight 0 and scatter harmlessly.
-        self._max_nnz = 1 << (max_nnz - 1).bit_length() if max_nnz else 0
+        # entries carry weight 0 and scatter harmlessly. The bucket
+        # FLOOR is a tuned knob (``sparse_nnz_floor``): a higher floor
+        # wastes pad entries but keeps more delta-drifted nnz inside
+        # one compiled scatter program.
+        if nnz_bucket_floor is None:
+            from .. import tuning
+
+            nnz_bucket_floor = int(
+                tuning.choose(
+                    "sparse_nnz_floor", n=self.n, v=self.v,
+                    nnz=int(c.rows.shape[0]), default=1,
+                )
+            )
+        self._nnz_bucket_floor = max(1, int(nnz_bucket_floor))
+        self._max_nnz = (
+            max(self._nnz_bucket_floor, 1 << (max_nnz - 1).bit_length())
+            if max_nnz else 0
+        )
         # Bounded LRU of densified tiles: default keeps ≤256 MB of C tiles
         # on device, so streaming passes over huge N don't accumulate the
         # whole dense C (which would defeat the tiled design).

@@ -46,6 +46,26 @@ from .ring import ring_allpairs_rowblock, ring_topk_rowblock
 _ALLGATHER_C_MAX_BYTES = 2 << 30
 
 
+def resolve_ring_kernel(n_rows: int, v_out: int, k: int) -> bool:
+    """Ring-step fold choice (``ring_kernel`` tuning knob): the rect
+    two-pass Pallas kernel vs the jnp fold — bit-identical results, so
+    this is purely a measured-performance pick. Feasibility (real
+    Pallas backend, kernel's (V, k) gate) is a hard override: a tuned
+    'rect-pallas' on a shape the kernel rejects silently folds. Callers
+    should resolve BEFORE a jitted boundary — a ``use_pallas=None``
+    passed into the jitted ring programs is resolved at trace time and
+    frozen into that program's cache entry."""
+    from .. import tuning
+    from ..ops import pallas_kernels as pk
+
+    feasible = pk.pallas_supported() and pk.rect_supported(v_out, k)
+    choice = tuning.choose(
+        "ring_kernel", n=n_rows, v=v_out,
+        default="rect-pallas" if feasible else "jnp-fold",
+    )
+    return choice == "rect-pallas" and feasible
+
+
 def choose_allpairs_strategy(
     n_rows: int, v_width: int, n_devices: int, itemsize: int = 4
 ) -> str:
@@ -153,14 +173,13 @@ def sharded_topk(
     the one psum above; "diagonal" (diag(M)[i] = Σ_v C[i,v]², textbook
     PathSim) is purely local — no collective at all."""
     if use_pallas is None:
-        from ..ops import pallas_kernels as pk
-
-        # feasibility must be part of the auto-gate: the rect kernel
-        # serves any V (un-tiled stripe kernel to V ≤ 512, the K-tiled
-        # variant beyond) but needs k < _CAND for self-exclusion
-        # headroom; shapes it rejects fall back to the jnp ring fold
+        # feasibility is part of the gate: the rect kernel serves any V
+        # (un-tiled stripe kernel to V ≤ 512, the K-tiled variant
+        # beyond) but needs k < _CAND for self-exclusion headroom;
+        # shapes it rejects fall back to the jnp ring fold whatever the
+        # tuning table says
         v_out = rest[-1].shape[1] if rest else first.shape[1]
-        use_pallas = pk.pallas_supported() and pk.rect_supported(v_out, k)
+        use_pallas = resolve_ring_kernel(first.shape[0], v_out, k)
     # check_vma is disabled on the Pallas ring path: the pallas_call's
     # internal loop discharge doesn't propagate varying-axis metadata
     # (jax raises "mismatched varying manual axes ... as a temporary
@@ -328,10 +347,8 @@ def sharded_topk_stepwise(
     digest, mesh size, compute path — is the CALLER's contract, like
     the jax-sparse tier's _run_config)."""
     if use_pallas is None:
-        from ..ops import pallas_kernels as pk
-
         v_out = rest[-1].shape[1] if rest else first.shape[1]
-        use_pallas = pk.pallas_supported() and pk.rect_supported(v_out, k)
+        use_pallas = resolve_ring_kernel(first.shape[0], v_out, k)
     n_dev = mesh.shape[axis]
     c, d = sharded_ring_state(first, tuple(rest), mesh=mesh, axis=axis,
                               variant=variant)

@@ -29,13 +29,13 @@ DEFAULT_BUCKETS: tuple[int, ...] = (1, 2, 4, 8, 16, 32)
 
 def bucket_ladder(max_batch: int) -> tuple[int, ...]:
     """Powers of two 1, 2, 4, … covering ``max_batch`` (the last bucket
-    is the smallest power of two ≥ max_batch)."""
-    if max_batch < 1:
-        raise ValueError(f"max_batch must be ≥ 1, got {max_batch}")
-    ladder = [1]
-    while ladder[-1] < max_batch:
-        ladder.append(ladder[-1] * 2)
-    return tuple(ladder)
+    is the smallest power of two ≥ max_batch). Delegates to the tuning
+    registry's ``resolve_ladder`` — the one implementation of ladder
+    geometry — so the untuned default can never drift from what a tuned
+    'pow2' choice resolves to."""
+    from ..tuning.registry import resolve_ladder
+
+    return resolve_ladder("pow2", max_batch)
 
 
 def bucket_for(n: int, buckets: tuple[int, ...]) -> int:

@@ -108,6 +108,16 @@ def build_serve_parser() -> argparse.ArgumentParser:
         help="disable the in-process metrics registry entirely "
         "(stats/metrics ops then report zeros)",
     )
+    p.add_argument(
+        "--tuning-table", default=None,
+        help="measured dispatch table from `dpathsim tune` (drives "
+        "kernel/tile/bucket choices incl. the warmup ladder); unusable "
+        "tables degrade to heuristics with a tuning_fallback event",
+    )
+    p.add_argument(
+        "--no-tuning", action="store_true",
+        help="ignore any tuning table (env included)",
+    )
     return p
 
 
@@ -137,6 +147,8 @@ def serve_main(argv: list[str] | None = None) -> int:
         approx=args.approx,
         headroom=args.headroom,
         echo=False,
+        tuning_table=args.tuning_table,
+        tuning=not args.no_tuning,
     )
     serve_config = ServeConfig(
         max_batch=args.max_batch,

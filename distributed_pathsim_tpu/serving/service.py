@@ -37,7 +37,6 @@ from ..obs.metrics import get_registry
 from ..obs.trace import get_tracer
 from ..ops import pathsim
 from ..utils.logging import runtime_event
-from . import buckets as bk
 from .cache import HotTileCache, ResultCache, graph_fingerprint
 from .coalescer import BatchStats, Coalescer, Request
 
@@ -120,6 +119,7 @@ class PathSimService:
             max_wait_ms=self.config.max_wait_ms,
             queue_depth=self.config.queue_depth,
             on_batch=self._record_batch,
+            bucket_ladder=self._bucket_ladder,
         )
 
     # -- warm state --------------------------------------------------------
@@ -147,12 +147,44 @@ class PathSimService:
         self._d = np.asarray(
             backend._denominators(self.variant), dtype=np.float64
         )
+        # Bucket-ladder geometry is a tuned knob (``serve_buckets``,
+        # keyed on (graph size, batch ceiling) — the ceiling rides the
+        # key's V axis): 'pow2' is the default ladder, 'coarse' halves
+        # the programs warmup must compile at <4x pad waste. The SAME
+        # ladder feeds warmup and the coalescer — a mismatch would
+        # dispatch a bucket warmup never compiled.
+        from .. import tuning
+
+        geometry = tuning.choose(
+            "serve_buckets", n=self.n, v=self.config.max_batch,
+            default="pow2",
+        )
+        if geometry not in tuning.KNOBS["serve_buckets"].candidates(
+            {"n": self.n}
+        ):
+            # unknown geometry from a stale table: heuristics, loudly.
+            # (Validated by name, not by catching resolve_ladder's
+            # ValueError — that would also swallow a max_batch config
+            # error and falsely blame the tuning table for it.)
+            runtime_event("tuning_bad_choice", knob="serve_buckets",
+                          choice=geometry)
+            geometry = "pow2"
+        self._bucket_ladder = tuning.resolve_ladder(
+            geometry, self.config.max_batch
+        )
+        # a reload/rebuild can land on a different ladder (n crossed a
+        # key bucket, or a table arrived): the LIVE coalescer must
+        # follow, or it would keep dispatching bucket sizes this warmup
+        # never compiled
+        coal = getattr(self, "coalescer", None)
+        if coal is not None:
+            coal.buckets = self._bucket_ladder
         if warm:
             from ..utils.xla_flags import warm_compile_cache
 
             warm_compile_cache(
                 backend,
-                bk.bucket_ladder(self.config.max_batch),
+                self._bucket_ladder,
                 k=self.config.k_default,
                 variant=self.variant,
             )
@@ -493,11 +525,19 @@ class PathSimService:
                     "p95_ms": round(cell.quantile(0.95) * 1e3, 4),
                     "p99_ms": round(cell.quantile(0.99) * 1e3, 4),
                 }
+        from .. import tuning
+
+        table = tuning.active_table()
         return {
             "obs": {
                 "latency": lat,
                 "tracing": get_tracer().enabled,
                 "metrics": get_registry().enabled,
+                "tuning": {
+                    "table": table.digest if table is not None else None,
+                    "lookups": tuning.lookup_stats(),
+                    "buckets": list(self._bucket_ladder),
+                },
             },
             "n": self.n,
             "metapath": self.metapath.name,

@@ -1,0 +1,41 @@
+"""Measured autotuning & shape-aware dispatch (DESIGN.md §21).
+
+Three pieces:
+
+- :mod:`.registry` — the candidate registry: every real tuning decision
+  point (fused_scores tile & Pallas-vs-XLA variant, fused_topk row
+  tile, K-contraction tile, sparse streaming tile width & scatter-pad
+  floor, ring-step kernel, serving bucket geometry) as a keyed knob.
+- :mod:`.autotuner` — the offline measurer (``dpathsim tune`` /
+  ``scripts/tune_sweep.py``): interleaved-arm, median-of-best timing
+  per ``(device, N-bucket, V-bucket, density-bucket, dtype)`` key.
+- :mod:`.table` + :mod:`.dispatch` — the versioned on-disk table and
+  the runtime consultation: exact hit → tuned choice, miss → nearest
+  bucket, unusable table → the built-in heuristics with one
+  ``tuning_fallback`` event.
+
+Tuning is bit-invisible: every choice routes between implementations
+that share the exact integer-count + f64-normalize scoring primitives
+(cross-variant parity is tested per backend in tests/test_tuning.py).
+"""
+
+from .dispatch import (  # noqa: F401
+    TUNING_TABLE_ENV,
+    active_table,
+    choose,
+    device_kind,
+    install_from_env,
+    install_table,
+    lookup_stats,
+    reset,
+    set_enabled,
+    set_table,
+)
+from .registry import KNOBS, resolve_ladder  # noqa: F401
+from .table import (  # noqa: F401
+    SCHEMA_VERSION,
+    TableError,
+    TuningTable,
+    load_table,
+    make_key,
+)

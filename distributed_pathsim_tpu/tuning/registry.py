@@ -1,0 +1,180 @@
+"""Candidate registry: every measured decision point, as a keyed knob.
+
+Before this subsystem each tier hardcoded its own tuning heuristic —
+``_default_scores_tiles`` in ops/pallas_kernels.py, the sparse
+column-tile width in backends/jax_sparse.py, the rect-Pallas-vs-jnp
+ring-step fold in parallel/sharded.py, the serving bucket ladder in
+serving/buckets.py. KERNELS_r05 showed why a constant can't be right:
+the promoted Pallas ``fused_scores`` tile wins at 8k authors and loses
+to XLA's fusion at 32k. The right variant/tile flips with matrix shape
+and density (Atrapos makes the same point for metapath workloads), so
+each decision point is registered here as a *knob*: a name, the
+candidate choices the offline autotuner may measure, and a short
+contract for what the choice means. Runtime code asks
+:func:`~distributed_pathsim_tpu.tuning.choose` for a knob's value and
+passes its own heuristic as the default — a missing/failed table means
+exactly the pre-tuning behavior.
+
+Every knob is **bit-invisible by construction**: choices only move work
+between tilings/variants that share the exact integer-count + f64-
+normalize scoring primitives (verified by the cross-variant parity
+tests in tests/test_tuning.py). A knob whose choices could change
+results does not belong in this registry.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+
+@dataclasses.dataclass(frozen=True)
+class Knob:
+    """One tunable decision point.
+
+    ``candidates``: context → the JSON-serializable choices the
+    autotuner may measure for this knob (context keys: n, v, nnz,
+    dtype, max_batch, k — whatever the knob's bench needs). The
+    runtime never enumerates candidates; it only validates that a
+    tuned choice is still *feasible* (VMEM budgets, kernel gates)
+    before using it.
+    """
+
+    name: str
+    doc: str
+    candidates: Callable[[dict], list[Any]]
+
+
+def _scores_tile_candidates(ctx: dict) -> list[Any]:
+    # The KERNELS_r05 sweep set; feasibility (VMEM fit at this V) is
+    # re-checked by the consumer, not assumed here.
+    return [[256, 256], [256, 512], [512, 256], [512, 512],
+            [512, 1024], [1024, 512]]
+
+
+KNOBS: dict[str, Knob] = {
+    k.name: k
+    for k in (
+        Knob(
+            name="scores_variant",
+            doc="all-pairs dense scores implementation: the fused "
+            "Pallas matmul+normalize kernel vs XLA's own fusion "
+            "(fused_scores_reference). KERNELS_r05: Pallas wins at 8k "
+            "(90.3% vs 86.7% of the f32 ceiling), XLA at 32k (87.0% "
+            "vs 85.3%).",
+            candidates=lambda ctx: ["pallas", "xla"],
+        ),
+        Knob(
+            name="scores_tile",
+            doc="fused_scores output tile (bm, bn): arithmetic "
+            "intensity per HBM byte grows with the tile edge, bounded "
+            "by the VMEM budget at this V.",
+            candidates=_scores_tile_candidates,
+        ),
+        Knob(
+            name="topk_rowtile",
+            doc="fused_topk row-tile (bm): rows folded per grid step "
+            "of the single-pass top-k kernel.",
+            candidates=lambda ctx: [256, 512],
+        ),
+        Knob(
+            name="k_tile",
+            doc="contraction tile (bk) of the K-tiled kernel variants "
+            "(wide half-chain factors, e.g. APA where V = #papers).",
+            candidates=lambda ctx: [256, 512, 1024],
+        ),
+        Knob(
+            name="sparse_tile_rows",
+            doc="jax-sparse streaming column/row tile width: the "
+            "[tile, tile] score block edge of the tiled sweep "
+            "(memory/throughput trade at a given N, V, density).",
+            candidates=lambda ctx: [
+                t for t in (1024, 2048, 4096, 8192)
+                if ctx.get("n") is None or t <= 4 * int(ctx["n"])
+            ],
+        ),
+        Knob(
+            name="sparse_nnz_floor",
+            doc="floor of the pow-2 per-tile scatter-pad bucket in "
+            "TiledHalfChain: a higher floor wastes pad entries but "
+            "keeps more delta-drifted nnz inside one compiled scatter "
+            "program.",
+            candidates=lambda ctx: [1, 1024, 4096, 16384],
+        ),
+        Knob(
+            name="ring_kernel",
+            doc="sharded ring-step fold: the rectangular two-pass "
+            "Pallas kernel vs the jnp fold (both bit-identical tie "
+            "breaks; parallel/ring.ring_topk_step).",
+            candidates=lambda ctx: ["rect-pallas", "jnp-fold"],
+        ),
+        Knob(
+            name="serve_buckets",
+            doc="serving bucket-ladder geometry pre-compiled at "
+            "warmup: 'pow2' (1,2,4,…; <2x pad waste, log2(B)+1 "
+            "programs) vs 'coarse' (1 + powers of 4; about half the "
+            "programs/warm time, <4x pad waste).",
+            candidates=lambda ctx: ["pow2", "coarse"],
+        ),
+    )
+}
+
+
+def resolve_ladder(geometry: str, max_batch: int) -> tuple[int, ...]:
+    """A ``serve_buckets`` choice → concrete ascending bucket ladder
+    covering ``max_batch``. Shared by the serving warmup, the
+    coalescer, and the tuner so geometry names can never drift."""
+    if max_batch < 1:
+        raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+    if geometry == "pow2":
+        step = 2
+    elif geometry == "coarse":
+        step = 4
+    else:
+        raise ValueError(f"unknown bucket geometry {geometry!r}")
+    ladder = [1]
+    while ladder[-1] < max_batch:
+        ladder.append(ladder[-1] * step)
+    return tuple(ladder)
+
+
+# ---------------------------------------------------------------------------
+# Sanctioned tile/bucket constants (scripts/lint_tuning.py)
+# ---------------------------------------------------------------------------
+#
+# Hardcoded tile/bucket constants outside this registry are exactly how
+# the pre-tuning heuristics fossilized, so the lint rejects NEW ones:
+# any module-level or class-level integer/tuple constant whose name
+# looks like a tile or bucket knob must either be a registry knob's
+# default (owned here) or appear below with its justification. Each
+# sanctioned entry is one of: (a) a kernel-internal layout invariant
+# that is NOT a performance choice (lane widths, packing factors), or
+# (b) the fallback floor a knob's heuristic returns when tuning is
+# absent — the registry's own documented default.
+
+SANCTIONED_CONSTANTS: dict[str, frozenset[str]] = {
+    "ops/pallas_kernels.py": frozenset({
+        "_BM",            # heuristic floor of scores_tile / topk_rowtile
+        "_BN",            # heuristic floor of scores_tile
+        "_BK",            # heuristic floor of k_tile
+        "_BN_WIDE",       # twopass candidate-extraction stripe (layout)
+        "_RECT_BN",       # rect kernel group tile — VMEM-stack-validated
+        "_RECT_VMAX",     # rect un-tiled contraction bound (VMEM layout)
+    }),
+    "backends/jax_dense.py": frozenset({
+        "_RECT_TILE_ROWS",  # rect streaming row tile (HBM-budget halver)
+    }),
+    "serving/buckets.py": frozenset({
+        "DEFAULT_BUCKETS",  # serve_buckets 'pow2' default, documented
+    }),
+    "obs/metrics.py": frozenset({
+        "DEFAULT_BUCKETS_PER_DECADE",  # histogram resolution (quantile
+        # rel-err bound is derived from it in tests) — an accuracy
+        # layout invariant, not a measured performance choice
+    }),
+    "serving/service.py": frozenset({
+        "tile_cache_bytes",  # ServeConfig capacity defaults: operator-
+        "tile_rows",         # facing CLI config (cache budget/eviction
+                             # granularity), not measured kernel knobs
+    }),
+}
