@@ -3,8 +3,8 @@
 
 PYTHON ?= python
 
-.PHONY: test chaos serve-smoke update-smoke obs-smoke lint-telemetry \
-	tune-smoke lint-tuning tune
+.PHONY: test chaos chaos-router serve-smoke update-smoke obs-smoke \
+	router-smoke lint-telemetry tune-smoke lint-tuning tune
 
 # Tier-1: the fast CPU suite (the driver's acceptance gate).
 test:
@@ -16,6 +16,25 @@ test:
 # PATHSIM_FAULT_PLAN injecting one transient failure per seam.
 chaos:
 	$(PYTHON) scripts/chaos_suite.py
+
+# Router chaos: the horizontal tier under its ambient fault plan
+# (transient worker-dispatch failures, a stall, dropped heartbeats, a
+# missed delta broadcast) plus a mid-batch worker SIGKILL. Gates: zero
+# lost requests, answers bit-identical to the single-process oracle.
+# The same scenario runs non-slow in tier-1 with the plan installed
+# in-process (tests/test_router.py::test_chaos_router_smoke).
+chaos-router:
+	$(PYTHON) scripts/chaos_suite.py --router
+
+# Router smoke: 2 real `dpathsim worker` subprocesses behind the
+# router, closed-loop load, one worker SIGKILLed mid-load. Hard gates:
+# zero lost requests, zero steady-state XLA recompiles on the
+# survivors, failover answers bit-identical to the single-process
+# oracle, and a measured (not claimed) 1-vs-2-replica QPS point. The
+# same run is wired as a non-slow pytest
+# (tests/test_router.py::test_bench_router_smoke), so tier-1 covers it.
+router-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) bench_serving.py --regime router --smoke
 
 # Serving smoke: the closed-loop load generator on a small fixed-seed
 # synthetic graph, with hard gates (warm-cache p50 < cold-cache p50,

@@ -659,6 +659,332 @@ def run_obs_smoke(out_path: str | None = None) -> dict:
     return result
 
 
+def _router_worker_argv(spec: str, backend: str, wid: str, max_batch: int,
+                        max_wait_ms: float, k: int) -> list[str]:
+    return [
+        sys.executable, "-m", "distributed_pathsim_tpu.cli", "worker",
+        "--worker-id", wid, "--dataset", spec, "--backend", backend,
+        "--platform", "cpu", "--max-batch", str(max_batch),
+        "--max-wait-ms", str(max_wait_ms), "--k", str(k),
+    ]
+
+
+def _spawn_router(n_workers: int, spec: str, backend: str, max_batch: int,
+                  max_wait_ms: float, k: int, hedge_ms: float = 150.0):
+    from distributed_pathsim_tpu.router import (
+        Router, RouterConfig, SubprocessTransport,
+    )
+
+    transports = {
+        f"w{i}": SubprocessTransport(
+            f"w{i}",
+            _router_worker_argv(spec, backend, f"w{i}", max_batch,
+                                max_wait_ms, k),
+        )
+        for i in range(n_workers)
+    }
+    router = Router(
+        transports,
+        RouterConfig(
+            heartbeat_interval_s=0.2,
+            # generous stall window: on a shared 2-core bench box the
+            # workers compete with the clients for CPU, and a slow pong
+            # is load, not death — kill detection rides the pipe EOF,
+            # which is immediate regardless
+            heartbeat_miss_limit=15,
+            hedge_ms=hedge_ms,
+            max_inflight=4096,
+        ),
+    )
+    router.start()
+    return router
+
+
+def _run_router_clients(router, schedule: list[list[int]], k: int) -> dict:
+    """Closed-loop load through the router: same contract as
+    _run_clients, plus failover/hedge accounting from the response
+    flags and a zero-lost-request ledger (every submitted request must
+    resolve ok)."""
+    from distributed_pathsim_tpu.router import RouterShed
+
+    lats: list[list[float]] = [[] for _ in schedule]
+    failover_lats: list[float] = []
+    errors: list[dict] = []
+    shed = [0]
+    hedged = [0]
+    barrier = threading.Barrier(len(schedule) + 1)
+
+    def client(ci: int, rows: list[int]) -> None:
+        barrier.wait()
+        for r in rows:
+            t0 = time.perf_counter()
+            try:
+                resp = router.request(
+                    {"id": ci, "op": "topk", "row": int(r), "k": k},
+                    timeout=60.0,
+                )
+            except RouterShed:
+                shed[0] += 1
+                continue
+            dt = time.perf_counter() - t0
+            if not resp.get("ok"):
+                errors.append(resp)
+                continue
+            lats[ci].append(dt)
+            if resp.get("failovers"):
+                failover_lats.append(dt)
+            if resp.get("hedged"):
+                hedged[0] += 1
+
+    threads = [
+        threading.Thread(target=client, args=(ci, rows), daemon=True)
+        for ci, rows in enumerate(schedule)
+    ]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    t0 = time.perf_counter()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    flat = [x for sub in lats for x in sub]
+    out = {
+        "queries": len(flat),
+        "lost": len(errors),
+        "errors": errors[:5],
+        "wall_s": round(wall, 4),
+        "qps": round(len(flat) / wall, 2) if wall > 0 else float("inf"),
+        "shed": shed[0],
+        "hedged": hedged[0],
+        "failover_affected": len(failover_lats),
+        **_percentiles(flat),
+    }
+    if failover_lats:
+        out["failover_recovery"] = _percentiles(failover_lats)
+    return out
+
+
+def run_router_bench(
+    n_authors: int = 2048,
+    n_papers: int = 4096,
+    n_venues: int = 48,
+    replicas: tuple = (1, 2, 4),
+    clients: int = 16,
+    queries_per_client: int = 48,
+    max_batch: int = 16,
+    max_wait_ms: float = 1.0,
+    k: int = 10,
+    backend: str = "jax",
+    seed: int = 0,
+    kill_phase: bool = True,
+) -> dict:
+    """The multi-process closed-loop regime: a QPS-vs-replicas curve
+    (each worker a real ``dpathsim worker`` subprocess over the same
+    synthetic graph), then a mid-load worker kill measuring failover —
+    detection time, recovery latency of the affected in-flight
+    requests, and the zero-lost-request ledger. A local single-process
+    numpy service is the bit-exactness oracle for a sampled subset of
+    the answered queries."""
+    import numpy as np
+
+    from distributed_pathsim_tpu.backends.base import create_backend
+    from distributed_pathsim_tpu.data.synthetic import synthetic_hin
+    from distributed_pathsim_tpu.ops.metapath import compile_metapath
+    from distributed_pathsim_tpu.serving import PathSimService, ServeConfig
+
+    spec = (
+        f"synthetic:authors={n_authors},papers={n_papers},"
+        f"venues={n_venues},seed={seed}"
+    )
+    rng = np.random.default_rng(seed)
+    hin = synthetic_hin(n_authors, n_papers, n_venues, seed=seed)
+    n = hin.type_size("author")
+    mp = compile_metapath("APVPA", hin.schema)
+    oracle = PathSimService(
+        create_backend("numpy", hin, mp),
+        config=ServeConfig(max_wait_ms=0.5, warm=False),
+    )
+    import os
+
+    uniform = rng.integers(0, n, size=(clients, queries_per_client))
+    out: dict = {
+        "graph": {"authors": n, "papers": n_papers, "venues": n_venues,
+                  "seed": seed},
+        "load": {"clients": clients,
+                 "queries_per_client": queries_per_client, "k": k,
+                 "max_batch": max_batch, "max_wait_ms": max_wait_ms},
+        "backend": backend,
+        "environment": {
+            "cpu_count": os.cpu_count(),
+            "note": (
+                "every worker is a real OS process pinned to the same "
+                "box as the router and the closed-loop clients; with "
+                "replicas >= cpu_count the curve measures CPU "
+                "oversubscription, not the tier. The robustness gates "
+                "(zero lost, zero recompiles, oracle bit-parity, "
+                "detection/recovery times) are load-invariant and are "
+                "the artifact's claim on this box; the scaling story "
+                "needs one host per worker."
+            ),
+        },
+        "replicas": {},
+    }
+    try:
+        for n_workers in replicas:
+            router = _spawn_router(n_workers, spec, backend, max_batch,
+                                   max_wait_ms, k)
+            try:
+                # warmup: touch the buckets, then measure steady state
+                # with the compile ledger open on every worker
+                _run_router_clients(router, uniform[:4, :8].tolist(), k)
+                h0 = _router_worker_compiles(router)
+                res = _run_router_clients(router, uniform.tolist(), k)
+                res["steady_state_compiles"] = sum(
+                    _router_worker_compiles(router).values()
+                ) - sum(h0.values())
+                res["oracle_checked"] = _router_oracle_check(
+                    router, oracle, rng, n, k, samples=16
+                )
+                out["replicas"][str(n_workers)] = res
+            finally:
+                router.close()
+        base = out["replicas"][str(replicas[0])]["qps"]
+        out["scaling"] = {
+            str(r): round(out["replicas"][str(r)]["qps"] / base, 2)
+            for r in replicas
+        }
+        if kill_phase:
+            out["failover"] = _router_kill_phase(
+                spec, backend, max_batch, max_wait_ms, k, uniform, oracle,
+                rng, n,
+            )
+    finally:
+        oracle.close()
+    return out
+
+
+def _router_worker_compiles(router) -> dict:
+    """Per-worker XLA compile counts, self-reported through a fresh
+    health round-trip (Router.worker_health probes and waits for the
+    pong, so the count reflects everything up to now)."""
+    counts = {}
+    for wid, w in router.workers.items():
+        if w.status != "up":
+            continue
+        counts[wid] = int(router.worker_health(wid).get("compiles", 0))
+    return counts
+
+
+def _router_oracle_check(router, oracle, rng, n, k, samples: int) -> dict:
+    """Bit-exactness: routed answers vs the single-process oracle —
+    exact ids, exact f64 scores, same tie order."""
+    import numpy as np
+
+    checked = mismatches = 0
+    for row in rng.integers(0, n, size=samples):
+        resp = router.request({"op": "topk", "row": int(row), "k": k},
+                              timeout=30)
+        if not resp.get("ok"):
+            mismatches += 1
+            continue
+        vals, idxs = oracle.topk_index(int(row), k)
+        want = [
+            (oracle._ident(int(j))[0], float(v))
+            for v, j in zip(vals, idxs) if np.isfinite(v)
+        ]
+        got = [(h["id"], h["score"]) for h in resp["result"]["topk"]]
+        checked += 1
+        if got != want:
+            mismatches += 1
+    return {"checked": checked, "mismatches": mismatches}
+
+
+def _router_kill_phase(spec, backend, max_batch, max_wait_ms, k, uniform,
+                       oracle, rng, n) -> dict:
+    """Two workers under load; SIGKILL one mid-batch. Measures
+    detection (kill → router marks it down), recovery (latency of the
+    requests the death orphaned), and the ledger: zero lost requests,
+    answers still oracle-exact afterward."""
+    import numpy as np
+
+    router = _spawn_router(2, spec, backend, max_batch, max_wait_ms, k,
+                           hedge_ms=300.0)
+    try:
+        _run_router_clients(router, uniform[:4, :8].tolist(), k)  # warm
+        detect = {}
+        started = threading.Event()
+
+        def killer():
+            started.wait()
+            time.sleep(0.05)  # mid-load: in-flight work must be orphaned
+            victim = router.workers["w0"]
+            t_kill = time.perf_counter()
+            victim.transport.kill()
+            while victim.status == "up":
+                time.sleep(0.001)
+            detect["detect_ms"] = round(
+                (time.perf_counter() - t_kill) * 1e3, 2
+            )
+
+        kt = threading.Thread(target=killer, daemon=True)
+        kt.start()
+        # enough closed-loop work that the kill lands INSIDE the run
+        # (the QPS phases finish a small schedule in well under a
+        # second on this graph)
+        schedule = np.tile(uniform, (1, 6)).tolist()
+        started.set()
+        res = _run_router_clients(router, schedule, k)
+        kt.join(timeout=30)
+        res.update(detect)
+        res["post_kill_oracle"] = _router_oracle_check(
+            router, oracle, rng, n, k, samples=8
+        )
+        return res
+    finally:
+        router.close()
+
+
+def run_router_smoke(out_path: str | None = None) -> dict:
+    """The tier-1 router gate (``make router-smoke``): 2 real worker
+    subprocesses on a small graph, closed-loop load, one SIGKILL mid
+    load. Hard gates: ZERO lost requests (every admitted query answers
+    ok despite the kill), zero steady-state XLA recompiles on the
+    surviving workers, failover answers bit-identical to the
+    single-process oracle, and the QPS curve exists (1 vs 2 replicas
+    measured, no scaling claim — a 2-core CI box cannot prove
+    scaling, only the artifact run on real hardware can)."""
+    result = run_router_bench(
+        n_authors=256, n_papers=448, n_venues=10,
+        replicas=(1, 2), clients=6, queries_per_client=16,
+        max_batch=8, max_wait_ms=1.0, k=5, kill_phase=True,
+    )
+    fo = result["failover"]
+    checks = {
+        "zero_lost_requests": all(
+            r["lost"] == 0 for r in result["replicas"].values()
+        ) and fo["lost"] == 0,
+        "zero_steady_state_recompiles": all(
+            r["steady_state_compiles"] == 0
+            for r in result["replicas"].values()
+        ),
+        "oracle_bit_identical": all(
+            r["oracle_checked"]["mismatches"] == 0
+            for r in result["replicas"].values()
+        ) and fo["post_kill_oracle"]["mismatches"] == 0,
+        "kill_detected": "detect_ms" in fo,
+        # the kill must have orphaned real in-flight work that then
+        # completed elsewhere — otherwise this run proved nothing
+        "failover_rerouted": fo["failover_affected"] > 0,
+    }
+    result["smoke_checks"] = checks
+    if out_path:
+        with open(out_path, "w", encoding="utf-8") as f:
+            json.dump(result, f, indent=2)
+    if not all(checks.values()):
+        raise AssertionError(f"router smoke failed: {checks}")
+    return result
+
+
 def run_smoke(out_path: str | None = None) -> dict:
     """Small fixed-seed run with the two hard gates tier-1 enforces."""
     result = run_bench(
@@ -688,10 +1014,14 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--smoke", action="store_true",
                    help="small fixed run with hard pass/fail gates")
     p.add_argument("--regime", default="load",
-                   choices=("load", "update", "obs"),
+                   choices=("load", "update", "obs", "router"),
                    help="'load': the closed-loop QPS regimes; 'update': "
                    "delta-ingestion vs reload latency; 'obs': "
-                   "observability overhead (obs on vs off, steady state)")
+                   "observability overhead (obs on vs off, steady "
+                   "state); 'router': multi-process QPS-vs-replicas "
+                   "curve + mid-load worker-kill failover")
+    p.add_argument("--replicas", default="1,2,4",
+                   help="router regime: comma-separated worker counts")
     p.add_argument("--edge-frac", type=float, default=0.01,
                    help="update regime: fraction of edges per Δ batch")
     p.add_argument("--reps", type=int, default=5,
@@ -711,7 +1041,25 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--out", default=None, help="write the JSON here")
     args = p.parse_args(argv)
 
-    if args.regime == "obs":
+    if args.regime == "router":
+        if args.smoke:
+            result = run_router_smoke(args.out)
+        else:
+            result = run_router_bench(
+                n_authors=args.authors, n_papers=args.papers,
+                n_venues=args.venues,
+                replicas=tuple(
+                    int(r) for r in args.replicas.split(",") if r.strip()
+                ),
+                clients=args.clients,
+                queries_per_client=args.queries_per_client,
+                max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
+                k=args.k, backend=args.backend, seed=args.seed,
+            )
+            if args.out:
+                with open(args.out, "w", encoding="utf-8") as f:
+                    json.dump(result, f, indent=2)
+    elif args.regime == "obs":
         if args.smoke:
             result = run_obs_smoke(args.out)
         else:

@@ -191,6 +191,19 @@ def main(argv: list[str] | None = None) -> int:
             msg = exc.args[0] if exc.args else exc
             print(f"error: {msg}", file=sys.stderr)
             return 1
+    if argv and argv[0] in ("worker", "router"):
+        # ``dpathsim worker`` — one serving replica speaking the
+        # router-facing async protocol; ``dpathsim router`` — the
+        # fault-tolerant fan-out over N of them (router/cli.py).
+        from .router.cli import router_main, worker_main
+
+        try:
+            entry = worker_main if argv[0] == "worker" else router_main
+            return entry(argv[1:])
+        except (KeyError, ValueError, FileNotFoundError) as exc:
+            msg = exc.args[0] if exc.args else exc
+            print(f"error: {msg}", file=sys.stderr)
+            return 1
     if argv and argv[0] == "tune":
         # ``dpathsim tune`` — offline autotuner: measure every knob's
         # candidate arms on THIS device and write the dispatch table

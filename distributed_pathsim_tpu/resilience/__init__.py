@@ -25,12 +25,20 @@ from typing import Callable, TypeVar
 from . import inject
 from .degrade import backend_chain, create_backend_resilient
 from .inject import FaultInjector, InjectedCrash, InjectedFault
-from .policy import RetryPolicy, TransientError, policy_from_env
+from .policy import (
+    Deadline,
+    DeadlineExceeded,
+    RetryPolicy,
+    TransientError,
+    policy_from_env,
+)
 from .preemption import PREEMPTED_EXIT_CODE, Preempted, handler as preemption_handler
 
 T = TypeVar("T")
 
 __all__ = [
+    "Deadline",
+    "DeadlineExceeded",
     "FaultInjector",
     "InjectedCrash",
     "InjectedFault",

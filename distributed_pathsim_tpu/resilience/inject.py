@@ -52,6 +52,16 @@ SEAMS = (
     "device_execute",
     "checkpoint_write",
     "multihost_init",
+    # Horizontal serving tier (router/): fired by a worker before each
+    # query dispatch, by the router before each heartbeat probe, and by
+    # the router before each per-worker delta send. An ``error`` at
+    # worker_dispatch is a retriable per-request failure the router
+    # reroutes; a ``delay`` simulates a stalled worker (hedging
+    # territory); an ``error`` at delta_broadcast makes that worker
+    # miss the update — the fencing machinery's test vector.
+    "worker_dispatch",
+    "heartbeat",
+    "delta_broadcast",
 )
 
 _KINDS = ("error", "crash", "delay", "partial", "preempt")

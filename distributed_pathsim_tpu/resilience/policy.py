@@ -131,6 +131,56 @@ class RetryPolicy:
         raise AssertionError("unreachable")  # pragma: no cover
 
 
+class Deadline:
+    """An absolute time budget, propagated end-to-end.
+
+    Born at the protocol edge from a request's ``deadline_ms`` field and
+    threaded through every layer that might wait, retry, or re-dispatch
+    (router failover, hedged sends, worker dispatch): each hop asks for
+    the *remaining* budget, so the sum of all retries can never overshoot
+    what the caller asked for. Monotonic-clock based — wall steps under
+    NTP must not expire (or resurrect) a request."""
+
+    __slots__ = ("t_deadline",)
+
+    def __init__(self, budget_s: float):
+        self.t_deadline = time.monotonic() + float(budget_s)
+
+    @classmethod
+    def from_ms(cls, deadline_ms: float | None) -> "Deadline | None":
+        """Protocol field → Deadline; None/absent means unbounded."""
+        if deadline_ms is None:
+            return None
+        return cls(float(deadline_ms) / 1e3)
+
+    def remaining_s(self) -> float:
+        return self.t_deadline - time.monotonic()
+
+    def remaining_ms(self) -> float:
+        return self.remaining_s() * 1e3
+
+    @property
+    def expired(self) -> bool:
+        return self.remaining_s() <= 0.0
+
+    def clamp(self, policy: "RetryPolicy") -> "RetryPolicy":
+        """Bound a retry policy by the remaining budget: the tighter of
+        the policy's own deadline and this one wins, so a seam's
+        environment-tuned deadline can shrink but never extend what the
+        caller granted."""
+        remaining = max(self.remaining_s(), 0.0)
+        if policy.deadline_s is None or policy.deadline_s > remaining:
+            return policy.replace(deadline_s=remaining)
+        return policy
+
+
+class DeadlineExceeded(RuntimeError):
+    """The caller's time budget ran out before an answer was produced.
+
+    NOT a TransientError: retrying an expired request only wastes the
+    replica a failover would have handed it to."""
+
+
 def _env_float(name: str, default: float | None) -> float | None:
     raw = os.environ.get(name)
     if raw is None or not raw.strip():

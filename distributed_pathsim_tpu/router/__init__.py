@@ -1,0 +1,41 @@
+"""Horizontal multi-host serving tier: router / worker split.
+
+One warm :class:`~..serving.PathSimService` is one failure domain; this
+package turns N of them into one fault-tolerant service (DESIGN.md §22,
+ROADMAP open item 4). Public surface:
+
+- :class:`Router` / :class:`RouterConfig` — the fan-out core: routes
+  queries over worker replicas (consistent-hash-by-row for cache
+  affinity, row-range alternative), re-dispatches the in-flight work of
+  a dead or stalled replica, hedges against the slow tail, broadcasts
+  deltas with ``(base_fp, delta_seq)`` fencing, sheds when every
+  replica is saturated, and drains gracefully on SIGTERM (core.py);
+- :class:`HashRing` / :class:`RangeRouter` — the routing policies
+  (hashring.py);
+- :class:`WorkerRuntime` / :func:`worker_loop` — the worker side of the
+  wire protocol: async query handling, request-id dedup, health probes,
+  graceful drain (worker.py);
+- :class:`SubprocessTransport` / :class:`InprocTransport` — how the
+  router reaches a worker: a real ``dpathsim worker`` child process, or
+  an in-process thread for deterministic chaos tests (transport.py);
+- the ``dpathsim router`` / ``dpathsim worker`` subcommands (cli.py).
+"""
+
+from .core import Router, RouterConfig, RouterShed
+from .hashring import HashRing, RangeRouter, make_policy
+from .transport import InprocTransport, SubprocessTransport, WorkerGone
+from .worker import WorkerRuntime, worker_loop
+
+__all__ = [
+    "HashRing",
+    "InprocTransport",
+    "RangeRouter",
+    "Router",
+    "RouterConfig",
+    "RouterShed",
+    "SubprocessTransport",
+    "WorkerGone",
+    "WorkerRuntime",
+    "make_policy",
+    "worker_loop",
+]

@@ -1,0 +1,338 @@
+"""``dpathsim router`` / ``dpathsim worker`` — the horizontal tier's CLIs.
+
+``worker`` is ``serve`` with the router-facing loop (router/worker.py):
+async query completion, health probes, request-id dedup, graceful
+drain. It accepts every serve flag plus ``--worker-id``, and grows one
+dataset scheme: ``--dataset synthetic:authors=..,papers=..,venues=..,
+seed=..`` builds the deterministic synthetic HIN in-process — the same
+graph for every worker given the same spec, which is what the router's
+same-base-fingerprint startup check enforces (and what lets tests and
+benches bring up a replica set with no file staging).
+
+``router`` spawns N ``worker`` children with the SAME serving flags,
+waits for their ready events, and speaks the serve JSONL protocol
+upstream on stdin/stdout — a drop-in horizontal replacement for one
+``dpathsim serve`` process::
+
+    dpathsim router --workers 2 --dataset dblp/dblp_small.gexf \
+        --backend jax --routing hash
+
+SIGTERM drains gracefully: new requests are rejected, in-flight ones
+complete, workers drain in turn, exit 0.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+
+from ..utils.logging import RunLogger, runtime_event, set_event_sink
+from .core import Router, RouterConfig, RouterShed
+from .transport import SubprocessTransport
+
+
+def _parse_synthetic(spec: str) -> dict:
+    """``synthetic:authors=384,papers=640,venues=12,seed=7`` → kwargs."""
+    fields = {}
+    body = spec.split(":", 1)[1]
+    for part in body.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        key, _, val = part.partition("=")
+        fields[key.strip()] = int(val)
+    kwargs = {
+        "n_authors": fields.pop("authors"),
+        "n_papers": fields.pop("papers"),
+        "n_venues": fields.pop("venues"),
+        "n_topics": fields.pop("topics", 0),
+        "seed": fields.pop("seed", 0),
+    }
+    if fields.pop("ids", 0):
+        kwargs["materialize_ids"] = True
+    if fields:
+        raise ValueError(f"unknown synthetic dataset fields {sorted(fields)}")
+    return kwargs
+
+
+def build_worker_parser() -> argparse.ArgumentParser:
+    from ..serving.cli import build_serve_parser
+
+    p = build_serve_parser()
+    p.prog = "dpathsim worker"
+    p.description = (
+        "router-facing PathSim worker: one warm replica speaking the "
+        "async JSONL protocol (health probes, request-id dedup, "
+        "graceful drain) on stdin/stdout"
+    )
+    p.add_argument("--worker-id", default="w0",
+                   help="replica identity (routing, events, heartbeats); "
+                   "must not contain ':'")
+    return p
+
+
+def _build_worker_service(args):
+    """Serve-flag args → warm PathSimService (GEXF through the engine
+    bootstrap; ``synthetic:`` specs built in-process)."""
+    from ..config import RunConfig
+    from ..serving.service import ServeConfig, build_service
+
+    serve_config = ServeConfig(
+        max_batch=args.max_batch,
+        max_wait_ms=args.max_wait_ms,
+        queue_depth=args.queue_depth,
+        cache_entries=args.cache_entries,
+        tile_cache_bytes=int(args.tile_cache_mb * (1 << 20)),
+        k_default=args.k,
+        warm=not args.no_warm,
+        batch_events=args.batch_events,
+        delta_threshold=args.delta_threshold,
+    )
+    if args.dataset.startswith("synthetic:"):
+        from ..backends.base import create_backend
+        from ..data.delta import with_headroom
+        from ..data.synthetic import synthetic_hin
+        from ..ops.metapath import compile_metapath
+        from ..serving.service import PathSimService
+
+        hin = synthetic_hin(**_parse_synthetic(args.dataset))
+        if args.headroom:
+            hin = with_headroom(hin, args.headroom)
+        metapath = compile_metapath(args.metapath, hin.schema)
+        return PathSimService(
+            create_backend(args.backend, hin, metapath),
+            variant=args.variant,
+            config=serve_config,
+        )
+    config = RunConfig(
+        dataset=args.dataset,
+        backend=args.backend,
+        metapath=args.metapath,
+        variant=args.variant,
+        loader=args.loader,
+        dtype=args.dtype,
+        n_devices=args.n_devices,
+        tile_rows=args.tile_rows,
+        approx=args.approx,
+        headroom=args.headroom,
+        echo=False,
+        tuning_table=args.tuning_table,
+        tuning=not args.no_tuning,
+    )
+    return build_service(config, serve_config)
+
+
+def worker_main(argv: list[str] | None = None) -> int:
+    args = build_worker_parser().parse_args(argv)
+    if ":" in args.worker_id:
+        raise ValueError("--worker-id must not contain ':'")
+    from ..cli import _apply_platform
+
+    _apply_platform(args.platform)
+
+    from .. import obs
+    from ..resilience import preemption_handler
+    from .worker import WorkerRuntime, worker_loop
+
+    obs.configure(
+        metrics=not args.no_metrics,
+        tracing=True if args.trace_out else None,
+        trace_sample=args.trace_sample,
+    )
+    exporter = (
+        obs.PrometheusTextfileExporter(
+            args.metrics_file, interval_s=args.metrics_interval
+        )
+        if args.metrics_file
+        else None
+    )
+    logger = RunLogger(output_path=None, echo=False,
+                       metrics_path=args.metrics)
+    set_event_sink(logger)
+    installed = preemption_handler.install()
+    service = None
+    try:
+        service = _build_worker_service(args)
+        if exporter is not None:
+            exporter.start()
+        runtime = WorkerRuntime(service, worker_id=args.worker_id)
+        print(
+            f"worker {args.worker_id}: {service.metapath.name} over "
+            f"{service.n} rows (backend={service.backend.name})",
+            file=sys.stderr,
+        )
+        return worker_loop(runtime, sys.stdin, sys.stdout)
+    finally:
+        if service is not None:
+            service.close()
+        if exporter is not None:
+            exporter.stop()  # final flush: the drain contract's tail
+        if args.trace_out:
+            print(obs.dump_trace(args.trace_out), file=sys.stderr)
+        if installed:
+            preemption_handler.uninstall()
+            preemption_handler.reset()
+        set_event_sink(None)
+        logger.close()
+
+
+# flags forwarded verbatim from the router's command line to each
+# worker child (store-value flags; store-true flags handled below)
+_FORWARD_VALUE = (
+    "dataset", "backend", "metapath", "variant", "loader", "platform",
+    "dtype", "k", "max_batch", "max_wait_ms", "queue_depth",
+    "cache_entries", "tile_cache_mb", "headroom", "delta_threshold",
+    "tuning_table",
+)
+_FORWARD_TRUE = ("no_warm", "no_metrics", "no_tuning", "approx")
+
+
+def build_router_parser() -> argparse.ArgumentParser:
+    from ..serving.cli import build_serve_parser
+
+    p = build_serve_parser()
+    p.prog = "dpathsim router"
+    p.description = (
+        "fault-tolerant horizontal serving: fan the serve JSONL "
+        "protocol over N dpathsim-worker replicas with failover, "
+        "hedging, and delta fencing"
+    )
+    p.add_argument("--workers", type=int, default=2,
+                   help="worker replica count")
+    p.add_argument("--routing", default="hash", choices=("hash", "range"),
+                   help="replica selection: consistent-hash-by-row "
+                   "(cache affinity) or contiguous row ranges")
+    p.add_argument("--hedge-ms", type=float, default=100.0,
+                   help="age at which an in-flight query is hedged to "
+                   "the next replica (0 disables)")
+    p.add_argument("--heartbeat-interval", type=float, default=0.25,
+                   help="seconds between health probes per worker")
+    p.add_argument("--heartbeat-miss", type=int, default=4,
+                   help="unanswered intervals before a worker is "
+                   "routed around")
+    p.add_argument("--max-inflight", type=int, default=512,
+                   help="router admission bound (pending requests)")
+    p.add_argument("--deadline-ms", type=float, default=None,
+                   help="default per-request budget when the client "
+                   "sends none")
+    return p
+
+
+def _worker_argv(args, index: int) -> list[str]:
+    argv = [sys.executable, "-m", "distributed_pathsim_tpu.cli", "worker",
+            "--worker-id", f"w{index}"]
+    for name in _FORWARD_VALUE:
+        val = getattr(args, name)
+        if val is None:
+            continue
+        argv += [f"--{name.replace('_', '-')}", str(val)]
+    for name in _FORWARD_TRUE:
+        if getattr(args, name):
+            argv.append(f"--{name.replace('_', '-')}")
+    return argv
+
+
+def router_loop(router: Router, in_stream, out_stream) -> int:
+    """Upstream JSONL loop: responses stream back as their futures
+    resolve (out of order; clients match on ``id``)."""
+    from ..resilience import preemption_handler
+
+    wlock = threading.Lock()
+
+    def respond(resp: dict) -> None:
+        line = json.dumps(resp) + "\n"
+        with wlock:
+            out_stream.write(line)
+            out_stream.flush()
+
+    for line in in_stream:
+        if preemption_handler.requested():
+            router.drain()
+            return 0
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            req = json.loads(line)
+            if not isinstance(req, dict):
+                raise ValueError("request must be a JSON object")
+        except ValueError as exc:
+            respond({"id": None, "ok": False, "error": f"bad request: {exc}"})
+            continue
+        op = req.get("op")
+        if op in ("shutdown", "drain"):
+            clean = router.drain()
+            respond({"id": req.get("id"), "ok": True,
+                     "result": {"shutdown": op == "shutdown",
+                                "draining": True, "clean": clean}})
+            return 0
+        try:
+            fut = router.submit(req)
+        except RouterShed as exc:
+            respond({"id": req.get("id"), "ok": False, "error": str(exc),
+                     "shed": True})
+            continue
+        fut.add_done_callback(lambda f: respond(f.result()))
+        if preemption_handler.requested():
+            router.drain()
+            return 0
+    router.drain()
+    return 0
+
+
+def router_main(argv: list[str] | None = None) -> int:
+    args = build_router_parser().parse_args(argv)
+    if args.workers < 1:
+        raise ValueError("--workers must be >= 1")
+    from .. import obs
+    from ..resilience import preemption_handler
+
+    obs.configure(metrics=not args.no_metrics)
+    exporter = (
+        obs.PrometheusTextfileExporter(
+            args.metrics_file, interval_s=args.metrics_interval
+        )
+        if args.metrics_file
+        else None
+    )
+    logger = RunLogger(output_path=None, echo=False,
+                       metrics_path=args.metrics)
+    set_event_sink(logger)
+    installed = preemption_handler.install()
+    transports = {
+        f"w{i}": SubprocessTransport(f"w{i}", _worker_argv(args, i))
+        for i in range(args.workers)
+    }
+    router = Router(
+        transports,
+        RouterConfig(
+            routing=args.routing,
+            hedge_ms=args.hedge_ms or None,
+            heartbeat_interval_s=args.heartbeat_interval,
+            heartbeat_miss_limit=args.heartbeat_miss,
+            max_inflight=args.max_inflight,
+            default_deadline_ms=args.deadline_ms,
+        ),
+    )
+    try:
+        router.start()
+        if exporter is not None:
+            exporter.start()
+        print(
+            f"router: {args.workers} workers, routing={args.routing}, "
+            f"n={router.n}; JSONL on stdin",
+            file=sys.stderr,
+        )
+        return router_loop(router, sys.stdin, sys.stdout)
+    finally:
+        runtime_event("router_exit", echo=False)
+        router.close()
+        if exporter is not None:
+            exporter.stop()
+        if installed:
+            preemption_handler.uninstall()
+            preemption_handler.reset()
+        set_event_sink(None)
+        logger.close()

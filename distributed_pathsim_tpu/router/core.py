@@ -1,0 +1,1011 @@
+"""The router core: fan requests over worker replicas, lose nothing.
+
+One :class:`Router` owns N worker transports and gives upstream clients
+the same JSONL protocol one ``dpathsim serve`` process speaks — with
+one process no longer being one failure domain. The design center is
+*robustness*, wired through the existing resilience primitives:
+
+- **Routing** (hashring.py): consistent-hash-by-row for cache affinity
+  (the same row keeps hitting the replica whose tiers hold it), or
+  contiguous row ranges; either yields a deterministic preference order
+  that failover, hedging, and fencing all walk.
+- **Failure detection**: per-worker heartbeats (``health`` op — pongs
+  carry queue depth and the consistency token) catch *death* and
+  *stalls* (miss limit exceeded → the worker is routed around and its
+  in-flight work re-dispatched); transport EOF/broken-pipe catches
+  death instantly. A stall-suspected worker that pongs again is
+  readmitted — suspicion is not a death sentence.
+- **Zero lost requests**: every admitted request lives in the pending
+  table until exactly one response resolves it. A worker dying
+  mid-batch re-dispatches its pending work to a surviving replica;
+  retried work is idempotent (dedup by ``request_id`` at both ends —
+  the worker replays mutation acks, the router keeps only the first
+  answer).
+- **Hedged requests**: a query in flight longer than the hedge
+  threshold gets a duplicate sent to the next replica in preference
+  order; first answer wins, the loser's arrival is counted and
+  dropped. This bounds the p99 a stalled-but-not-dead replica causes.
+- **Deadlines**: the protocol's ``deadline_ms`` budget is re-computed
+  at every (re)dispatch — a failover or hedge never grants more time
+  than the caller has left, and an expired budget fails fast instead
+  of burning a replica (resilience.Deadline).
+- **Admission**: the pending table is bounded; past it, submissions
+  shed (:class:`RouterShed`) — and a worker that sheds locally pushes
+  the request to the next replica, so the router only sheds when every
+  replica is saturated.
+- **Delta fencing**: ``update`` broadcasts carry the chained
+  ``(base_fp, delta_seq)`` token. The router records each epoch's
+  affected-row set; a replica that missed a broadcast is *fenced* —
+  never handed a query for an affected row — until catch-up (ordered
+  replay of the missed updates, idempotent by request id) brings its
+  token to the head. No stale row can escape.
+
+Chaos seams: ``heartbeat`` (a probe that never happened) and
+``delta_broadcast`` (a worker missing an update) fire here;
+``worker_dispatch`` fires in the worker (worker.py). See
+tests/test_router.py and ``make chaos-router``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import threading
+import time
+from concurrent.futures import Future
+
+from ..obs.metrics import get_registry
+from ..resilience import Deadline, inject
+from ..utils.logging import runtime_event
+from .hashring import make_policy
+from .transport import WorkerGone
+
+ROUTED_OPS = frozenset({"topk", "scores"})
+
+# worker statuses
+UP = "up"
+SUSPECT = "suspect"      # heartbeat-missed: routed around, resurrectable
+DOWN = "down"            # transport-dead: gone for good
+
+
+class RouterShed(RuntimeError):
+    """Admission refused: the router's pending table is at its bound
+    (or every replica is saturated)."""
+
+
+@dataclasses.dataclass
+class RouterConfig:
+    routing: str = "hash"            # hash | range
+    vnodes: int = 64
+    max_inflight: int = 512          # admission bound on pending requests
+    default_deadline_ms: float | None = None
+    heartbeat_interval_s: float = 0.25
+    heartbeat_miss_limit: int = 4    # unanswered intervals before SUSPECT
+    hedge_ms: float | None = 100.0   # None disables hedged requests
+    worker_queue_limit: int = 256    # per-replica saturation threshold
+    max_attempts: int = 4            # distinct replicas tried per request
+    update_timeout_s: float = 60.0
+    drain_timeout_s: float = 30.0
+    # how long a request may sit PARKED (no replica currently eligible:
+    # every candidate suspected or fenced) before it fails; a transient
+    # all-suspect blip — e.g. a stalled box starving every worker of
+    # CPU for a second — must not turn into client-visible errors
+    park_timeout_s: float = 10.0
+
+
+class _WorkerState:
+    __slots__ = (
+        "wid", "transport", "status", "epoch", "queue_depth",
+        "last_pong", "assigned", "catchup_active", "token",
+        "last_health", "pong_seq",
+    )
+
+    def __init__(self, wid: str, transport):
+        self.wid = wid
+        self.transport = transport
+        self.status = UP
+        self.epoch = 0               # index into the router's epoch log
+        self.queue_depth = 0
+        self.last_pong = time.monotonic()
+        self.assigned: set[str] = set()   # request ids in flight here
+        self.catchup_active = False
+        self.token: tuple[str, int] | None = None
+        self.last_health: dict = {}
+        self.pong_seq = 0
+
+
+class _Pending:
+    __slots__ = (
+        "rid", "req", "key", "row", "future", "deadline", "tried",
+        "assigned", "hedged", "hedge_sent", "t0", "failovers", "parked",
+    )
+
+    def __init__(self, rid: str, req: dict, key, row, future, deadline):
+        self.rid = rid
+        self.req = req
+        self.key = key
+        self.row = row
+        self.future = future
+        self.deadline = deadline
+        self.tried: list[str] = []
+        self.assigned: set[str] = set()
+        self.hedged = False      # hedge CONSIDERED (one shot per request)
+        self.hedge_sent = False  # hedge actually dispatched
+        self.failovers = 0
+        self.parked = False
+        self.t0 = time.monotonic()
+
+
+class _Epoch:
+    """One entry of the delta log: the consistency token after this
+    update, the wire request to replay for catch-up, and the rows it
+    affected (None = all rows; epoch 0 is the base graph)."""
+
+    __slots__ = ("token", "wire_req", "affected", "rid")
+
+    def __init__(self, token, wire_req=None, affected=None, rid=None):
+        self.token = tuple(token)
+        self.wire_req = wire_req
+        self.affected = affected
+        self.rid = rid
+
+
+class _UpdatePending:
+    __slots__ = ("rid", "client_id", "future", "waiting", "acks",
+                 "failures", "t0", "epoch_index", "first_result", "wire")
+
+    def __init__(self, rid, client_id, future, waiting, wire):
+        self.rid = rid
+        self.client_id = client_id
+        self.future = future
+        self.waiting: set[str] = set(waiting)
+        self.acks: dict[str, dict] = {}
+        self.failures: dict[str, str] = {}
+        self.t0 = time.monotonic()
+        self.epoch_index: int | None = None
+        self.first_result: dict | None = None
+        self.wire = wire  # replayable request (catch-up; same request_id)
+
+
+class Router:
+    """Owns worker transports and the pending table. ``transports`` is
+    ``{worker_id: transport}`` (not yet started); :meth:`start` brings
+    them up, verifies they serve the same graph, and starts the
+    heartbeat/hedge maintenance thread."""
+
+    def __init__(self, transports: dict, config: RouterConfig | None = None):
+        if not transports:
+            raise ValueError("router needs at least one worker")
+        self.config = config or RouterConfig()
+        self._lock = threading.RLock()
+        self.workers: dict[str, _WorkerState] = {
+            wid: _WorkerState(wid, t) for wid, t in transports.items()
+        }
+        self._pending: dict[str, _Pending] = {}
+        self._updates: dict[str, _UpdatePending] = {}
+        self._epochs: list[_Epoch] = []
+        self._epoch_by_token: dict[tuple, int] = {}
+        self._compacted_to = 0
+        self._rid_seq = itertools.count(1)
+        self._hb_seq = itertools.count(1)
+        self._update_seq = itertools.count(1)
+        self._update_lock = threading.Lock()  # serializes broadcasts
+        self._draining = False
+        self._closed = threading.Event()
+        self._maintenance: threading.Thread | None = None
+        self.policy = None
+        self.n = 0
+        # counters (per-process registry; the router is one per process)
+        reg = get_registry()
+        self._m_requests = reg.counter(
+            "dpathsim_router_requests_total",
+            "router requests by outcome",
+        )
+        self._m_failovers = reg.counter(
+            "dpathsim_router_failovers_total",
+            "re-dispatches after worker death/stall/retriable failure",
+        )
+        self._m_hedges = reg.counter(
+            "dpathsim_router_hedges_total", "hedged duplicate dispatches"
+        ).labels()
+        self._m_dups = reg.counter(
+            "dpathsim_router_dup_responses_total",
+            "late/duplicate worker responses dropped by request-id dedup",
+        ).labels()
+        self._m_fence_skips = reg.counter(
+            "dpathsim_router_fence_skips_total",
+            "routing decisions that skipped a fenced replica",
+        ).labels()
+        self._m_latency = reg.histogram(
+            "dpathsim_router_request_seconds",
+            "router submit-to-resolve latency by outcome",
+        )
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self, ready_timeout: float = 180.0) -> None:
+        for w in self.workers.values():
+            w.transport.start(self._on_message, self._on_death)
+        tokens = {}
+        for w in self.workers.values():
+            info = w.transport.wait_ready(ready_timeout)
+            tokens[w.wid] = (info.get("base_fp"), int(info.get("delta_seq", 0)))
+            w.token = tokens[w.wid]
+            self.n = int(info.get("n", self.n))
+        base = next(iter(tokens.values()))
+        if any(t != base for t in tokens.values()):
+            raise ValueError(
+                f"workers disagree on the base graph: {tokens} — every "
+                "replica must serve the same dataset/config"
+            )
+        self._epochs.append(_Epoch(token=base))
+        self._epoch_by_token[tuple(base)] = 0
+        # pong clocks start NOW, not at construction: worker startup
+        # (backend build + warmup) happens between __init__ and here,
+        # and counting it as silence would mark every worker stalled
+        # on the first probe
+        now = time.monotonic()
+        for w in self.workers.values():
+            w.last_pong = now
+        self.policy = make_policy(
+            self.config.routing, list(self.workers), n_rows=max(self.n, 1),
+            vnodes=self.config.vnodes,
+        )
+        self._maintenance = threading.Thread(
+            target=self._maintenance_loop, name="pathsim-router-maint",
+            daemon=True,
+        )
+        self._maintenance.start()
+        runtime_event(
+            "router_ready", workers=len(self.workers), n=self.n,
+            routing=self.config.routing, fingerprint=base[0],
+        )
+
+    def close(self) -> None:
+        self._closed.set()
+        for w in self.workers.values():
+            w.transport.close()
+
+    def drain(self) -> bool:
+        """Graceful stop: reject new work, resolve everything pending,
+        drain the workers. True if everything flushed in time."""
+        with self._lock:
+            self._draining = True
+        deadline = time.monotonic() + self.config.drain_timeout_s
+        clean = True
+        while time.monotonic() < deadline:
+            with self._lock:
+                if not self._pending and not self._updates:
+                    break
+            time.sleep(0.005)
+        else:
+            clean = False
+        for w in self.workers.values():
+            if w.transport.alive:
+                try:
+                    w.transport.terminate()
+                except Exception:
+                    pass
+        runtime_event(
+            "router_drain", clean=clean,
+            pending=len(self._pending), updates=len(self._updates),
+        )
+        return clean
+
+    # -- submission --------------------------------------------------------
+
+    def submit(self, req: dict) -> Future:
+        """Admit one protocol request; returns a Future of the response
+        dict. Raises :class:`RouterShed` at the admission bound."""
+        op = req.get("op", "topk")
+        fut: Future = Future()
+        if self._draining:
+            fut.set_result({
+                "id": req.get("id"), "ok": False, "error": "draining",
+                "draining": True,
+            })
+            return fut
+        if op == "ping":
+            fut.set_result({"id": req.get("id"), "ok": True,
+                            "result": {"pong": True}})
+            return fut
+        if op in ("stats", "health"):
+            fut.set_result({"id": req.get("id"), "ok": True,
+                            "result": self.stats()})
+            return fut
+        if op == "update":
+            return self._submit_update(req, fut)
+        if op == "invalidate":
+            return self._submit_invalidate(req, fut)
+        if op not in ROUTED_OPS:
+            fut.set_result({"id": req.get("id"), "ok": False,
+                            "error": f"unknown op {op!r}"})
+            return fut
+        with self._lock:
+            if len(self._pending) >= self.config.max_inflight:
+                self._m_requests.inc(outcome="shed")
+                runtime_event(
+                    "router_shed", depth=self.config.max_inflight,
+                    echo=False,
+                )
+                raise RouterShed(
+                    f"router pending table at bound "
+                    f"({self.config.max_inflight})"
+                )
+            rid = f"r{next(self._rid_seq)}"
+            row = req.get("row")
+            row = int(row) if row is not None else None
+            key = row if row is not None else str(
+                req.get("source") or req.get("source_id") or ""
+            )
+            deadline = Deadline.from_ms(
+                req.get("deadline_ms", self.config.default_deadline_ms)
+            )
+            p = _Pending(rid, req, key, row, fut, deadline)
+            self._pending[rid] = p
+        verdict = self._dispatch(p)
+        if verdict is not None:
+            self._park_or_fail(p, verdict)
+        return fut
+
+    def request(self, req: dict, timeout: float = 60.0) -> dict:
+        """Synchronous convenience: submit + wait."""
+        return self.submit(req).result(timeout=timeout)
+
+    # -- routing -----------------------------------------------------------
+
+    def _eligible(self, p: _Pending, exclude) -> tuple[str | None, str]:
+        """Pick the next replica for ``p`` under the lock. Returns
+        (worker_id, reason-if-none)."""
+        saturated = fenced = exhausted = 0
+        for wid in self.policy.preference(p.key):
+            w = self.workers[wid]
+            if w.status != UP or not w.transport.alive:
+                continue
+            if wid in exclude:
+                exhausted += 1  # alive, but this request already tried it
+                continue
+            if self._fenced(w, p.row):
+                fenced += 1
+                self._m_fence_skips.inc()
+                continue
+            if w.queue_depth >= self.config.worker_queue_limit:
+                saturated += 1
+                continue
+            return wid, ""
+        if saturated:
+            return None, "saturated"
+        if fenced:
+            return None, "fenced"
+        if exhausted:
+            # every live replica already refused this request (shed /
+            # transient failure): surface that, don't park — the client
+            # retrying later IS the backoff
+            return None, "exhausted"
+        return None, "no live workers"
+
+    def _fenced(self, w: _WorkerState, row: int | None) -> bool:
+        """Is this replica forbidden from answering for ``row``? True
+        when it missed a delta whose affected set could cover the query
+        (unknown rows — label queries — only go to caught-up replicas
+        while any fence is active)."""
+        head = len(self._epochs) - 1
+        if w.epoch >= head:
+            return False
+        for epoch in self._epochs[w.epoch + 1:]:
+            if epoch.affected is None or row is None:
+                return True
+            if row in epoch.affected:
+                return True
+        return False
+
+    def _dispatch(self, p: _Pending, exclude: set | None = None) -> str | None:
+        """Send ``p`` to the best eligible replica. None on success, an
+        error string when no replica can take it."""
+        exclude = set(exclude or ())
+        while True:
+            if p.deadline is not None and p.deadline.expired:
+                return "deadline exceeded"
+            with self._lock:
+                if p.rid not in self._pending:
+                    return None  # already resolved (late failover race)
+                if len(p.tried) >= self.config.max_attempts:
+                    return "max attempts exhausted"
+                wid, why = self._eligible(p, exclude | set(p.tried))
+                if wid is None:
+                    return why
+                w = self.workers[wid]
+                p.tried.append(wid)
+                p.assigned.add(wid)
+                w.assigned.add(p.rid)
+            wire = dict(p.req)
+            wire["id"] = p.rid
+            wire["request_id"] = p.rid
+            if p.deadline is not None:
+                wire["deadline_ms"] = max(p.deadline.remaining_ms(), 0.0)
+            try:
+                w.transport.send(wire)
+                return None
+            except WorkerGone:
+                with self._lock:
+                    p.assigned.discard(wid)
+                    w.assigned.discard(p.rid)
+                self._mark_down(wid, DOWN, "send failed")
+                exclude.add(wid)
+
+    # -- resolution --------------------------------------------------------
+
+    def _resolve(self, p: _Pending, resp: dict) -> None:
+        elapsed = time.monotonic() - p.t0
+        client_resp = dict(resp)
+        client_resp["id"] = p.req.get("id")
+        client_resp["request_id"] = p.rid
+        outcome = "ok" if resp.get("ok") else "error"
+        if p.failovers:
+            client_resp["failovers"] = p.failovers
+        if p.hedge_sent:
+            client_resp["hedged"] = True
+        self._m_requests.inc(outcome=outcome)
+        self._m_latency.observe(elapsed, outcome=outcome)
+        p.future.set_result(client_resp)
+
+    def _park_or_fail(self, p: _Pending, verdict: str) -> None:
+        """No replica can take ``p`` right now. Hard verdicts fail;
+        saturation sheds (the ISSUE contract: when every replica is
+        saturated the router says so immediately, it does not queue
+        unboundedly); transient unavailability — every candidate
+        suspected or fenced — PARKS the request for the maintenance
+        loop to retry, because a worker coming back (pong) or catching
+        up (delta replay) makes it dispatchable again."""
+        if verdict in ("deadline exceeded", "max attempts exhausted"):
+            self._fail(p, verdict)
+            return
+        if verdict == "saturated":
+            self._fail(p, "all replicas saturated", shed=True)
+            return
+        if verdict == "exhausted":
+            self._fail(p, "all replicas refused", shed=True)
+            return
+        with self._lock:
+            recoverable = any(
+                w.status in (UP, SUSPECT) and (
+                    w.transport.alive or w.status == SUSPECT
+                )
+                for w in self.workers.values()
+            )
+            if recoverable and p.rid in self._pending:
+                p.parked = True
+                p.tried.clear()  # a resurrected replica gets a fresh try
+                runtime_event("router_parked", rid=p.rid,
+                              reason=verdict, echo=False)
+                return
+        self._fail(p, verdict)
+
+    def _retry_parked(self, now: float) -> None:
+        ready: list[_Pending] = []
+        cfg = self.config
+        with self._lock:
+            for p in self._pending.values():
+                if p.parked:
+                    ready.append(p)
+        for p in ready:
+            if p.deadline is not None and p.deadline.expired:
+                self._fail(p, "deadline exceeded")
+                continue
+            if (
+                p.deadline is None
+                and now - p.t0 > cfg.park_timeout_s
+            ):
+                self._fail(p, "no live workers")
+                continue
+            with self._lock:
+                if p.rid not in self._pending:
+                    continue
+                p.parked = False
+            verdict = self._dispatch(p)
+            if verdict is not None:
+                self._park_or_fail(p, verdict)
+
+    def _fail(self, p: _Pending, error: str, **flags) -> None:
+        with self._lock:
+            if self._pending.pop(p.rid, None) is None:
+                return
+            for wid in p.assigned:
+                self.workers[wid].assigned.discard(p.rid)
+        resp = {"ok": False, "error": error, **flags}
+        if error == "deadline exceeded":
+            resp["deadline_exceeded"] = True
+        if error in ("saturated", "shed"):
+            resp["shed"] = True
+        self._resolve(p, resp)
+
+    def _on_message(self, wid: str, obj: dict) -> None:
+        if "event" in obj:
+            return  # ready/drained events: informational here
+        rid = obj.get("id")
+        if isinstance(rid, str) and rid.startswith("hb:"):
+            self._on_pong(wid, obj)
+            return
+        if isinstance(rid, str) and rid.startswith(("up:", "cu:")):
+            self._on_update_ack(wid, rid, obj)
+            return
+        if isinstance(rid, str) and rid.startswith("inv:"):
+            return  # broadcast invalidate ack: fire-and-forget
+
+        with self._lock:
+            p = self._pending.get(rid) if isinstance(rid, str) else None
+            if p is not None and obj.get("ok"):
+                del self._pending[rid]
+                for awid in p.assigned:
+                    self.workers[awid].assigned.discard(rid)
+        if p is None:
+            # hedge loser, or a stall-suspected worker answering after
+            # its work was already failed over — dedup: drop + count
+            self._m_dups.inc()
+            return
+        if obj.get("ok"):
+            self._resolve(p, obj)
+            return
+        # failed response: reroute retriable failures, surface the rest
+        retriable = bool(
+            obj.get("shed") or obj.get("draining") or obj.get("transient")
+        ) and not obj.get("deadline_exceeded")
+        if not retriable:
+            with self._lock:
+                if self._pending.pop(p.rid, None) is None:
+                    return
+                for awid in p.assigned:
+                    self.workers[awid].assigned.discard(p.rid)
+            self._resolve(p, obj)
+            return
+        with self._lock:
+            p.assigned.discard(wid)
+            self.workers[wid].assigned.discard(p.rid)
+            if p.assigned:
+                return  # a hedge is still in flight; let it race
+        p.failovers += 1
+        self._m_failovers.inc(reason="worker_error")
+        verdict = self._dispatch(p)
+        if verdict is not None:
+            self._park_or_fail(p, verdict)
+
+    def _on_death(self, wid: str, reason: str) -> None:
+        self._mark_down(wid, DOWN, reason)
+
+    def _mark_down(self, wid: str, status: str, reason: str) -> None:
+        with self._lock:
+            w = self.workers.get(wid)
+            if w is None or w.status == DOWN:
+                return
+            if w.status == status:
+                return
+            w.status = status
+            orphans = [
+                self._pending[rid]
+                for rid in w.assigned
+                if rid in self._pending
+            ]
+            w.assigned.clear()
+            for p in orphans:
+                p.assigned.discard(wid)
+        runtime_event(
+            "router_worker_down", worker_id=wid, status=status,
+            reason=reason, orphaned=len(orphans),
+        )
+        get_registry().counter(
+            "dpathsim_router_worker_down_total",
+            "workers marked down/suspect, by cause",
+        ).inc(status=status)
+        for p in orphans:
+            with self._lock:
+                if p.rid not in self._pending or p.assigned:
+                    continue  # resolved meanwhile, or hedged elsewhere
+            p.failovers += 1
+            self._m_failovers.inc(reason=reason.split(" ")[0] or "death")
+            verdict = self._dispatch(p)
+            if verdict is not None:
+                self._park_or_fail(p, verdict)
+
+    # -- heartbeats, stall detection, hedging ------------------------------
+
+    def _maintenance_loop(self) -> None:
+        cfg = self.config
+        interval = cfg.heartbeat_interval_s
+        hedge_s = (cfg.hedge_ms / 1e3) if cfg.hedge_ms else None
+        tick = min(interval, (hedge_s / 4) if hedge_s else interval)
+        tick = max(tick, 0.005)
+        next_probe = 0.0
+        while not self._closed.wait(tick):
+            now = time.monotonic()
+            if now >= next_probe:
+                next_probe = now + interval
+                self._probe_workers(now)
+            if hedge_s is not None:
+                self._hedge_scan(now, hedge_s)
+            self._retry_parked(now)
+            self._sweep_updates(now)
+
+    def _probe_workers(self, now: float) -> None:
+        cfg = self.config
+        for w in list(self.workers.values()):
+            if w.status == DOWN or not w.transport.alive:
+                continue
+            try:
+                # the heartbeat seam: an injected error here is a probe
+                # that never happened — enough of them and a healthy
+                # worker goes SUSPECT (and comes back at the next pong)
+                inject.fire("heartbeat")
+                w.transport.send(
+                    {"id": f"hb:{w.wid}:{next(self._hb_seq)}",
+                     "op": "health"}
+                )
+            except inject.InjectedFault:
+                pass
+            except WorkerGone:
+                self._mark_down(w.wid, DOWN, "heartbeat send failed")
+                continue
+            silence = now - w.last_pong
+            if (
+                w.status == UP
+                and silence > cfg.heartbeat_interval_s * cfg.heartbeat_miss_limit
+            ):
+                self._mark_down(
+                    w.wid, SUSPECT,
+                    f"stall {silence * 1e3:.0f}ms without pong",
+                )
+
+    def _on_pong(self, wid: str, obj: dict) -> None:
+        if not obj.get("ok"):
+            return
+        result = obj.get("result") or {}
+        token = (result.get("base_fp"), int(result.get("delta_seq", 0)))
+        catchup_from = None
+        with self._lock:
+            w = self.workers.get(wid)
+            if w is None or w.status == DOWN:
+                return
+            w.last_pong = time.monotonic()
+            w.queue_depth = int(result.get("queue_depth", 0))
+            w.token = token
+            w.last_health = result
+            w.pong_seq += 1
+            if w.status == SUSPECT:
+                # the stall cleared: readmit (its in-flight work was
+                # already failed over; dedup absorbs any late answers)
+                w.status = UP
+                runtime_event("router_worker_up", worker_id=wid,
+                              echo=False)
+            epoch = self._epoch_of(token)
+            if epoch is None:
+                # a token outside our history: divergent replica —
+                # fence it from everything (epoch −1 predates epoch 0)
+                w.epoch = -1
+            else:
+                w.epoch = max(w.epoch, epoch)
+            if (
+                w.epoch < len(self._epochs) - 1
+                and not w.catchup_active
+            ):
+                w.catchup_active = True
+                catchup_from = w.epoch + 1
+            self._compact_epochs()
+        if catchup_from is not None:
+            self._send_catchup(wid, catchup_from)
+
+    def _epoch_of(self, token) -> int | None:
+        return self._epoch_by_token.get(tuple(token))
+
+    def _compact_epochs(self) -> None:
+        """Drop the replay payload (and affected set) of epochs every
+        live replica has passed — called under the lock after an epoch
+        advance. Without this a long-lived router retains every delta's
+        full edge lists forever. Compacted entries keep their token
+        (the epoch index must stay stable) with ``affected=None``,
+        which only a divergent (epoch −1) replica would ever consult —
+        and None means "all rows", exactly the conservative fence such
+        a replica already gets."""
+        live = [
+            w.epoch for w in self.workers.values()
+            if w.status != DOWN and w.epoch >= 0
+        ]
+        if not live:
+            return
+        horizon = min(live)
+        for i in range(max(self._compacted_to, 1), horizon + 1):
+            self._epochs[i].wire_req = None
+            self._epochs[i].affected = None
+        self._compacted_to = max(self._compacted_to, horizon + 1)
+
+    def _hedge_scan(self, now: float, hedge_s: float) -> None:
+        stragglers: list[_Pending] = []
+        with self._lock:
+            for p in self._pending.values():
+                if p.hedged or (now - p.t0) < hedge_s:
+                    continue
+                if p.deadline is not None and p.deadline.expired:
+                    continue
+                if len(p.assigned) != 1:
+                    continue
+                p.hedged = True  # one hedge attempt per request
+                stragglers.append(p)
+        for p in stragglers:
+            # a failed hedge dispatch is not a request failure — the
+            # original is still in flight; only a hedge that actually
+            # went out is counted and flagged (a 1-replica router must
+            # not fabricate hedge accounting)
+            if self._dispatch(p, exclude=set(p.tried)) is None and (
+                len(p.assigned) > 1
+            ):
+                p.hedge_sent = True
+                self._m_hedges.inc()
+                runtime_event(
+                    "router_hedge", rid=p.rid, row=p.row,
+                    waited_ms=round((now - p.t0) * 1e3, 1), echo=False,
+                )
+
+    # -- delta broadcast & fencing -----------------------------------------
+
+    def _submit_update(self, req: dict, fut: Future) -> Future:
+        with self._update_lock:
+            seq = next(self._update_seq)
+            urid = f"u{seq}"
+            wire = dict(req)
+            wire["request_id"] = urid
+            wire["want_rows"] = True
+            wire.pop("id", None)  # per-worker ids are stamped per send
+            with self._lock:
+                targets = [
+                    w for w in self.workers.values()
+                    if w.status == UP and w.transport.alive
+                ]
+                if not targets:
+                    fut.set_result({"id": req.get("id"), "ok": False,
+                                    "error": "no live workers"})
+                    return fut
+                up = _UpdatePending(
+                    urid, req.get("id"), fut, [w.wid for w in targets],
+                    wire,
+                )
+                self._updates[urid] = up
+            for w in targets:
+                per_wire = dict(wire)
+                per_wire["id"] = f"up:{w.wid}:{seq}"
+                try:
+                    # the delta_broadcast seam: an injected error means
+                    # THIS worker misses the update — it will lag the
+                    # token head and be fenced until catch-up
+                    inject.fire("delta_broadcast")
+                    w.transport.send(per_wire)
+                except (inject.InjectedFault, WorkerGone) as exc:
+                    self._update_failure(urid, w.wid, repr(exc))
+        return fut
+
+    def _on_update_ack(self, wid: str, rid: str, obj: dict) -> None:
+        """An ``update`` response — from the broadcast (``up:``) or a
+        catch-up replay (``cu:``). Either way the ack's token tells us
+        where this replica now stands in the epoch log."""
+        urid = f"u{rid.rsplit(':', 1)[1]}"
+        is_catchup = rid.startswith("cu:")
+        if not obj.get("ok"):
+            if is_catchup:
+                with self._lock:
+                    w = self.workers.get(wid)
+                    if w is not None:
+                        # drop the in-progress flag: the next pong
+                        # showing lag retries the replay
+                        w.catchup_active = False
+                runtime_event(
+                    "router_catchup_failed", worker_id=wid, rid=urid,
+                    error=obj.get("error", "?"),
+                )
+            else:
+                self._update_failure(urid, wid, obj.get("error", "?"))
+            return
+        result = obj.get("result") or {}
+        token = (result.get("base_fp"), int(result.get("delta_seq", 0)))
+        finished = None
+        next_catchup = None
+        with self._lock:
+            up = self._updates.get(urid)
+            if up is not None:
+                if up.epoch_index is None:
+                    # first ack defines the epoch: its token and
+                    # affected set (None = rebuild = all rows). Later
+                    # acks must agree — replicas are deterministic.
+                    affected = result.get("affected_row_list")
+                    self._epochs.append(_Epoch(
+                        token=token,
+                        wire_req=up.wire,
+                        affected=(
+                            frozenset(affected) if affected is not None
+                            else None
+                        ),
+                        rid=urid,
+                    ))
+                    up.epoch_index = len(self._epochs) - 1
+                    self._epoch_by_token[tuple(token)] = up.epoch_index
+                    up.first_result = result
+                elif tuple(token) != self._epochs[up.epoch_index].token:
+                    runtime_event(
+                        "router_token_divergence", worker_id=wid,
+                        got=token,
+                        expected=self._epochs[up.epoch_index].token,
+                    )
+            w = self.workers.get(wid)
+            if w is not None:
+                epoch = self._epoch_of(token)
+                w.token = token
+                w.epoch = epoch if epoch is not None else -1
+                if is_catchup:
+                    if 0 <= w.epoch < len(self._epochs) - 1:
+                        next_catchup = w.epoch + 1  # keep replaying
+                    else:
+                        w.catchup_active = False
+            if up is not None:
+                up.waiting.discard(wid)
+                up.acks[wid] = result
+                # a replica that missed the broadcast but caught up
+                # before the update finished has APPLIED it — it must
+                # not be reported as both applied and lagging
+                up.failures.pop(wid, None)
+                if not up.waiting:
+                    finished = self._updates.pop(urid)
+            self._compact_epochs()
+        if next_catchup is not None:
+            self._send_catchup(wid, next_catchup)
+        if finished is not None:
+            self._finish_update(finished)
+
+    def _update_failure(self, urid: str, wid: str, error: str) -> None:
+        finished = None
+        with self._lock:
+            up = self._updates.get(urid)
+            if up is None:
+                return
+            up.waiting.discard(wid)
+            up.failures[wid] = error
+            if not up.waiting:
+                finished = self._updates.pop(urid)
+        runtime_event(
+            "router_update_miss", worker_id=wid, rid=urid, error=error,
+        )
+        if finished is not None:
+            self._finish_update(finished)
+
+    def _finish_update(self, up: _UpdatePending) -> None:
+        ok = up.epoch_index is not None
+        result = {
+            "applied": sorted(up.acks),
+            "missed": dict(up.failures),
+            "lagging": sorted(up.failures),
+        }
+        if up.first_result is not None:
+            result.update({
+                k: up.first_result[k]
+                for k in ("mode", "affected_rows", "delta_seq", "base_fp",
+                          "fingerprint", "n")
+                if k in up.first_result
+            })
+        runtime_event(
+            "router_update", rid=up.rid, applied=len(up.acks),
+            missed=len(up.failures), echo=False,
+        )
+        up.future.set_result({
+            "id": up.client_id, "ok": ok,
+            **({"result": result} if ok else
+               {"error": "update applied on no replica", "detail": result}),
+        })
+
+    def _sweep_updates(self, now: float) -> None:
+        expired: list[_UpdatePending] = []
+        with self._lock:
+            for urid, up in list(self._updates.items()):
+                if now - up.t0 > self.config.update_timeout_s:
+                    for wid in list(up.waiting):
+                        up.failures[wid] = "ack timeout"
+                    up.waiting.clear()
+                    expired.append(self._updates.pop(urid))
+        for up in expired:
+            self._finish_update(up)
+
+    def _send_catchup(self, wid: str, from_epoch: int) -> None:
+        """Replay the FIRST missed update to a lagging replica; its ack
+        advances the epoch and triggers the next replay (ordered — a
+        delta chain applied out of order is a different graph)."""
+        with self._lock:
+            w = self.workers.get(wid)
+            if w is None or w.status != UP:
+                if w is not None:
+                    w.catchup_active = False
+                return
+            if from_epoch >= len(self._epochs) or from_epoch < 1:
+                w.catchup_active = False
+                return
+            epoch = self._epochs[from_epoch]
+            if epoch.wire_req is None:
+                # nothing replayable (shouldn't happen: every epoch > 0
+                # records its wire request) — leave the replica fenced
+                w.catchup_active = False
+                runtime_event(
+                    "router_catchup_impossible", worker_id=wid,
+                    epoch=from_epoch,
+                )
+                return
+            wire = dict(epoch.wire_req)
+            wire["id"] = f"cu:{wid}:{epoch.rid[1:]}"
+        runtime_event(
+            "router_catchup", worker_id=wid, epoch=from_epoch,
+            rid=epoch.rid, echo=False,
+        )
+        try:
+            w.transport.send(wire)
+        except WorkerGone:
+            self._mark_down(wid, DOWN, "catchup send failed")
+
+    def _submit_invalidate(self, req: dict, fut: Future) -> Future:
+        acked = 0
+        for w in list(self.workers.values()):
+            if w.status != UP or not w.transport.alive:
+                continue
+            try:
+                w.transport.send({
+                    "id": f"inv:{w.wid}", "op": "invalidate",
+                })
+                acked += 1
+            except WorkerGone:
+                self._mark_down(w.wid, DOWN, "send failed")
+        fut.set_result({
+            "id": req.get("id"), "ok": True,
+            "result": {"invalidated": True, "workers": acked},
+        })
+        return fut
+
+    # -- introspection -----------------------------------------------------
+
+    def worker_health(self, wid: str, timeout: float = 10.0) -> dict:
+        """A FRESH health snapshot from one worker: probe, wait for the
+        pong (benches read compile counts around a measurement window,
+        so a cached pong from before the window is not good enough)."""
+        with self._lock:
+            w = self.workers.get(wid)
+            if w is None or w.status == DOWN:
+                return {}
+            seq0 = w.pong_seq
+        try:
+            w.transport.send(
+                {"id": f"hb:{wid}:{next(self._hb_seq)}", "op": "health"}
+            )
+        except WorkerGone:
+            return {}
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if w.pong_seq > seq0:
+                    return dict(w.last_health)
+            time.sleep(0.005)
+        return {}
+
+    def stats(self) -> dict:
+        with self._lock:
+            head = len(self._epochs) - 1
+            return {
+                "router": {
+                    "workers": {
+                        w.wid: {
+                            "status": w.status,
+                            "queue_depth": w.queue_depth,
+                            "assigned": len(w.assigned),
+                            "epoch": w.epoch,
+                            "lag": head - w.epoch,
+                            "token": list(w.token) if w.token else None,
+                        }
+                        for w in self.workers.values()
+                    },
+                    "pending": len(self._pending),
+                    "updates_pending": len(self._updates),
+                    "epochs": head + 1,
+                    "routing": self.config.routing,
+                    "draining": self._draining,
+                    "n": self.n,
+                },
+            }

@@ -178,6 +178,13 @@ def serve_main(argv: list[str] | None = None) -> int:
     logger = RunLogger(output_path=None, echo=False,
                        metrics_path=args.metrics)
     set_event_sink(logger)
+    # SIGTERM/SIGINT → graceful drain, NOT the batch CLI's
+    # checkpoint-and-exit-75: serve_loop notices the latched request at
+    # the next protocol event, completes in-flight work, flushes the
+    # final metrics snapshot, and returns 0 (serving/protocol.py).
+    from ..resilience import preemption_handler
+
+    installed = preemption_handler.install()
     service = None
     try:
         service = build_service(config, serve_config)
@@ -199,5 +206,8 @@ def serve_main(argv: list[str] | None = None) -> int:
             exporter.stop()  # final write: shutdown state preserved
         if args.trace_out:
             print(obs.dump_trace(args.trace_out), file=sys.stderr)
+        if installed:
+            preemption_handler.uninstall()
+            preemption_handler.reset()
         set_event_sink(None)
         logger.close()

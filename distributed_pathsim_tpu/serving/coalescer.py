@@ -178,6 +178,21 @@ class Coalescer:
         self._dispatcher.start()
         self._completer.start()
 
+    # -- load signals ------------------------------------------------------
+
+    @property
+    def depth(self) -> int:
+        """Admitted-but-undispatched requests right now (the health
+        op's queue-depth signal; routers shed/route on it)."""
+        with self._lock:
+            return len(self._queue)
+
+    @property
+    def inflight(self) -> int:
+        """Batches issued but not yet fully completed."""
+        with self._lock:
+            return self._inflight_n
+
     # -- admission ---------------------------------------------------------
 
     def submit(self, row: int, k: int, span=None,

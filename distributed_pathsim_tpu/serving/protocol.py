@@ -17,6 +17,31 @@ Requests::
     {"id": 6, "op": "ping"}
     {"id": 7, "op": "shutdown"}
     {"id": 9, "op": "metrics"}
+    {"id": 10, "op": "health"}
+    {"id": 11, "op": "drain"}
+    {"id": 12, "op": "topk", "row": 17, "request_id": "r42",
+     "deadline_ms": 250.0}
+
+Two optional fields extend EVERY request, defaulted so yesterday's
+clients keep working unchanged:
+
+- ``request_id`` — a caller-chosen globally-unique identity (the
+  router stamps one per admitted request). Responses echo it, and
+  retried/hedged dispatches reuse it so duplicated work is
+  *idempotent*: the receiver can dedup, and whoever fans responses
+  back out keeps only the first. Absent → responses omit it.
+- ``deadline_ms`` — the caller's remaining time budget, counted from
+  receipt of the request. An expired budget fails fast with
+  ``deadline_exceeded`` instead of dispatching; downstream waits and
+  retries (:class:`~..resilience.Deadline` threaded into
+  ``RetryPolicy``) are clamped so they can never overshoot it.
+
+The ``health`` op is the heartbeat/probe endpoint: O(1) liveness plus
+the load signals a router routes on (queue depth, in-flight count) and
+the replica-consistency token ``(base_fp, delta_seq)`` that fences a
+replica lagging on delta broadcasts. The ``drain`` op is the in-band
+graceful-shutdown request (the protocol twin of SIGTERM): stop
+accepting, complete in-flight, flush, exit 0.
     {"id": 8, "op": "update",
      "add_nodes": [{"type": "author", "id": "a_new", "label": "A. New"}],
      "add_edges": [{"rel": "author_of", "src": "a_new", "dst": "paper_7"}],
@@ -50,6 +75,8 @@ from typing import IO
 
 from ..obs.metrics import get_registry
 from ..obs.trace import get_tracer
+from ..resilience import Deadline, DeadlineExceeded
+from ..utils.logging import runtime_event
 from .service import PathSimService
 
 _QUERY_KEYS = ("source", "source_id", "row")
@@ -126,7 +153,10 @@ def metrics_snapshot(service: PathSimService) -> dict:
     }
 
 
-def _dispatch_op(service: PathSimService, op: str, req: dict):
+def _dispatch_op(
+    service: PathSimService, op: str, req: dict,
+    deadline: Deadline | None = None,
+):
     """The op table: one request's work, exceptions propagating to the
     caller's per-request error envelope."""
     if op == "ping":
@@ -135,6 +165,8 @@ def _dispatch_op(service: PathSimService, op: str, req: dict):
         return service.stats()
     if op == "metrics":
         return metrics_snapshot(service)
+    if op == "health":
+        return service.health()
     if op == "invalidate":
         service.invalidate()
         return {"invalidated": True}
@@ -142,7 +174,11 @@ def _dispatch_op(service: PathSimService, op: str, req: dict):
         kwargs = {key: req.get(key) for key in _QUERY_KEYS}
         if all(v is None for v in kwargs.values()):
             raise KeyError("topk needs one of source / source_id / row")
-        hits = service.topk(k=req.get("k"), **kwargs)
+        hits = service.topk(
+            k=req.get("k"),
+            timeout_s=deadline.remaining_s() if deadline else None,
+            **kwargs,
+        )
         return {
             "topk": [
                 {"id": i, "label": lab, "score": s} for i, lab, s in hits
@@ -157,7 +193,7 @@ def _dispatch_op(service: PathSimService, op: str, req: dict):
             add_edges=req.get("add_edges", ()),
             remove_edges=req.get("remove_edges", ()),
         )
-        return service.update(delta)
+        return service.update(delta, want_rows=bool(req.get("want_rows")))
     if op == "scores":
         row = service.resolve(
             source=req.get("source"),
@@ -172,27 +208,63 @@ def handle_request(service: PathSimService, req: dict) -> dict:
     """One request dict → one response dict (transport-free core)."""
     rid = req.get("id")
     op = req.get("op", "topk")
+    # the end-to-end time budget, counted from receipt; expired budgets
+    # fail fast — dispatching work nobody is still waiting for wastes
+    # the very capacity an overloaded caller needs back
+    deadline = Deadline.from_ms(req.get("deadline_ms"))
+    request_id = req.get("request_id")
     latency_cell, error_cell = _op_cells(op)
     t0 = time.perf_counter()
     try:
+        if deadline is not None and deadline.expired:
+            raise DeadlineExceeded(
+                f"deadline_ms={req.get('deadline_ms')} expired on arrival"
+            )
         # protocol-level span: the outermost segment of a served
         # request's trace (the serve.request root parents under it on
         # query ops)
         with get_tracer().span("serve.op", op=op):
-            result = _dispatch_op(service, op, req)
+            result = _dispatch_op(service, op, req, deadline=deadline)
     except Exception as exc:  # per-request failure, not process failure
         latency_cell.observe(time.perf_counter() - t0)
         error_cell.inc()
         msg = exc.args[0] if exc.args else repr(exc)
-        return {"id": rid, "ok": False, "error": str(msg)}
+        resp = {"id": rid, "ok": False, "error": str(msg)}
+        if isinstance(exc, DeadlineExceeded) or (
+            deadline is not None and deadline.expired
+        ):
+            # machine-readable cause: a router must know "out of time"
+            # (do NOT reroute) from "this replica failed" (do reroute)
+            resp["deadline_exceeded"] = True
+        if request_id is not None:
+            resp["request_id"] = request_id
+        return resp
     latency_s = time.perf_counter() - t0
     latency_cell.observe(latency_s)
-    return {
+    resp = {
         "id": rid,
         "ok": True,
         "result": result,
         "latency_ms": round(latency_s * 1e3, 3),
     }
+    if request_id is not None:
+        resp["request_id"] = request_id
+    return resp
+
+
+def _drain(service: PathSimService, reason: str) -> None:
+    """The graceful-drain epilogue, shared by the in-band ``drain`` op
+    and SIGTERM: wait out the in-flight pipeline (every accepted request
+    completes — the zero-lost-request half of the contract), then emit
+    the final accounting event so the metrics channel records the
+    shutdown state."""
+    service.coalescer.drain()
+    runtime_event(
+        "serve_drain",
+        reason=reason,
+        requests=service.coalescer.dispatched_requests,
+        shed=service.coalescer.shed_count,
+    )
 
 
 def serve_loop(
@@ -200,8 +272,27 @@ def serve_loop(
 ) -> int:
     """Read JSONL requests until EOF or a ``shutdown`` op; write one
     JSONL response per request, flushed per line (a pipe peer must see
-    the answer without waiting for buffering)."""
+    the answer without waiting for buffering).
+
+    SIGTERM (via the resilience preemption handler, installed by the
+    serve CLI) and the in-band ``drain`` op both trigger a *graceful
+    drain* instead of the batch CLI's checkpoint-and-exit-75: the
+    current request completes and is answered, the coalescer pipeline
+    flushes, a final ``serve_drain`` event lands on the metrics channel,
+    and the loop returns 0 — no accepted request is ever dropped. Lines
+    not yet read when the drain begins were never accepted; the closed
+    response stream is the client's signal to fail them over. (A drain
+    latched mid-wait takes effect at the next protocol event — the next
+    request line or EOF — because the reader blocks in the stream.)"""
+    from ..resilience import preemption_handler
+
     for line in in_stream:
+        if preemption_handler.requested():
+            # a signal landed while we were blocked on the read: the
+            # just-read line was never accepted — drain and exit before
+            # handling it (its sender sees EOF, not silence-then-drop)
+            _drain(service, preemption_handler.reason or "signal")
+            return 0
         line = line.strip()
         if not line:
             continue
@@ -221,6 +312,19 @@ def serve_loop(
             )
             out_stream.flush()
             return 0
+        if req.get("op") == "drain":
+            out_stream.write(
+                json.dumps({"id": req.get("id"), "ok": True,
+                            "result": {"draining": True}}) + "\n"
+            )
+            out_stream.flush()
+            _drain(service, "drain op")
+            return 0
         out_stream.write(json.dumps(handle_request(service, req)) + "\n")
         out_stream.flush()
+        if preemption_handler.requested():
+            # SIGTERM during the request just answered: it completed
+            # and its response is flushed — now drain and exit
+            _drain(service, preemption_handler.reason or "signal")
+            return 0
     return 0

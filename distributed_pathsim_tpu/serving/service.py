@@ -347,11 +347,16 @@ class PathSimService:
             return fut
         return self.coalescer.submit(int(row), k, span=root, t_submit=t0)
 
-    def topk_index(self, row: int, k: int | None = None):
-        """Synchronous top-k by dense row index → (values, indices)."""
-        return self.submit_topk(row, k).result(
-            timeout=self.config.request_timeout_s
-        )
+    def topk_index(self, row: int, k: int | None = None,
+                   timeout_s: float | None = None):
+        """Synchronous top-k by dense row index → (values, indices).
+        ``timeout_s`` caps the wait below the service-wide default —
+        the protocol's ``deadline_ms`` budget lands here, so a request
+        whose caller has given up stops blocking a worker slot."""
+        timeout = self.config.request_timeout_s
+        if timeout_s is not None:
+            timeout = min(timeout, max(timeout_s, 0.0))
+        return self.submit_topk(row, k).result(timeout=timeout)
 
     def _ident(self, i: int) -> tuple[str, str]:
         """(id, label) for a dense index — huge synthetic graphs carry
@@ -363,11 +368,12 @@ class PathSimService:
         return f"{self.node_type}_{i}", f"{self.node_type}_{i}"
 
     def topk(self, source: str | None = None, source_id: str | None = None,
-             row: int | None = None, k: int | None = None):
+             row: int | None = None, k: int | None = None,
+             timeout_s: float | None = None):
         """Synchronous top-k by label / id / row, resolved to ids:
         list of (target_id, target_label, score)."""
         r = self.resolve(source=source, source_id=source_id, row=row)
-        vals, idxs = self.topk_index(r, k)
+        vals, idxs = self.topk_index(r, k, timeout_s=timeout_s)
         return [
             (*self._ident(int(i)), float(v))
             for v, i in zip(vals, idxs)
@@ -404,7 +410,43 @@ class PathSimService:
         self.tile_cache.clear()
         runtime_event("serve_invalidate", fingerprint=self._fp)
 
-    def update(self, delta) -> dict:
+    @property
+    def consistency_token(self) -> tuple[str, int]:
+        """The replica-consistency token ``(base_fp, delta_seq)``: two
+        replicas with equal tokens have applied the same delta chain to
+        the same base graph and therefore serve bit-identical answers.
+        A router fences a replica whose token lags the broadcast head
+        (DESIGN.md §22)."""
+        return (self._base_fp, self._delta_seq)
+
+    def health(self) -> dict:
+        """The heartbeat payload: O(1) liveness + the load signals a
+        router routes on + the consistency token that fences a lagging
+        replica. Deliberately cheap — a probe must stay answerable even
+        when the query path is saturated."""
+        c = self.coalescer
+        return {
+            "ok": True,
+            "n": self.n,
+            "queue_depth": c.depth,
+            "inflight": c.inflight,
+            "shed": c.shed_count,
+            "base_fp": self._base_fp,
+            "delta_seq": self._delta_seq,
+            "fingerprint": self._fp,
+            "backend": self.backend.name,
+            # process-lifetime XLA compile count: a steady-state worker
+            # whose number moves is violating the shape-bucket contract
+            # (the router smoke's zero-recompile gate reads this)
+            "compiles": int(
+                get_registry().counter(
+                    "dpathsim_xla_compiles_total",
+                    "XLA backend compilations since process start",
+                ).labels().value
+            ),
+        }
+
+    def update(self, delta, want_rows: bool = False) -> dict:
         """Absorb a :class:`~..data.delta.DeltaBatch` into the WARM
         service — the recompile-free alternative to :meth:`reload`.
 
@@ -437,6 +479,7 @@ class PathSimService:
                     mode, reason = "rebuild", str(exc)
             else:
                 mode = "rebuild"
+            affected_list: list[int] | None = None
             if mode == "rebuild":
                 self._install_backend(
                     self._backend_factory(plan.hin_new),
@@ -461,6 +504,11 @@ class PathSimService:
                 self._delta_seq += 1
                 self._fp = plan.fingerprint
                 affected_n = int(affected.shape[0])
+                if want_rows:
+                    # the router's fencing machinery needs the SET, not
+                    # the count: a replica that missed this delta is
+                    # fenced for exactly these rows until caught up
+                    affected_list = [int(r) for r in affected]
                 self._update_stats["deltas"] += 1
                 self._update_stats["purged_rows"] += purged
             ms = round((time.perf_counter() - t0) * 1e3, 3)
@@ -481,7 +529,7 @@ class PathSimService:
                 fingerprint=self._fp,
                 ms=ms,
             )
-            return {
+            result = {
                 "mode": mode,
                 "reason": reason,
                 "edge_changes": plan.n_edge_changes,
@@ -489,10 +537,16 @@ class PathSimService:
                 "affected_rows": affected_n,
                 "purged_entries": purged,
                 "delta_seq": self._delta_seq,
+                "base_fp": self._base_fp,
                 "fingerprint": self._fp,
                 "n": self.n,
                 "ms": ms,
             }
+            if want_rows:
+                # None under rebuild: "all rows" — the fence must cover
+                # everything, not an empty set
+                result["affected_row_list"] = affected_list
+            return result
 
     def reload(self, backend: PathSimBackend) -> None:
         """Swap in a freshly built backend (graph reload): drain the

@@ -50,6 +50,20 @@ AMBIENT_PLAN = ",".join(
     ]
 )
 
+# The horizontal tier's ambient plan (``make chaos-router``): transient
+# per-request dispatch failures, a worker stall, dropped heartbeats, and
+# a missed delta broadcast — on top of the mid-batch SIGKILL the router
+# chaos test performs itself. The gates are zero lost requests and
+# bit-identical answers (tests/test_router.py::test_chaos_router_smoke).
+ROUTER_PLAN = ",".join(
+    [
+        "worker_dispatch:error:3",
+        "worker_dispatch:delay:1:0.05",
+        "heartbeat:error:2",
+        "delta_broadcast:error:1@1",
+    ]
+)
+
 BASE_ARGS = [
     "-m",
     "pytest",
@@ -87,9 +101,18 @@ def main(argv=None) -> int:
                        help="ambient pass only")
     group.add_argument("--targeted", action="store_true",
                        help="targeted pass only")
+    group.add_argument("--router", action="store_true",
+                       help="router pass only (the horizontal tier "
+                       "under ROUTER_PLAN; `make chaos-router`)")
     args = ap.parse_args(argv)
 
     rc = 0
+    if args.router:
+        return _run(
+            "router (horizontal tier under ROUTER_PLAN)",
+            ["-m", "chaos and not slow", "-k", "router"],
+            {"PATHSIM_FAULT_PLAN": ROUTER_PLAN},
+        )
     if not args.ambient:
         rc |= _run(
             "targeted (chaos-marked tests, per-test plans)",

@@ -46,6 +46,9 @@ class Rule:
     why: str
     # relative paths (from the package root) wholly exempt from the rule
     allowed_files: frozenset[str]
+    # when set, the rule applies only to files under this prefix
+    # (package-relative) — for subsystem-scoped discipline
+    only_under: str | None = None
 
 
 RULES = (
@@ -68,7 +71,7 @@ RULES = (
         ),
         allowed_files=frozenset(
             {"utils/logging.py", "cli.py", "serving/cli.py",
-             "neural_cli.py"}
+             "neural_cli.py", "router/cli.py"}
         ),
     ),
     Rule(
@@ -80,6 +83,29 @@ RULES = (
             "call runtime_event()"
         ),
         allowed_files=frozenset({"utils/logging.py"}),
+    ),
+    Rule(
+        name="raw-stream-write",
+        pattern=re.compile(r"sys\.std(err|out)\.write"),
+        why=(
+            "direct stream writes skip the event sink's lock (stderr) "
+            "or corrupt a JSONL wire protocol (stdout) — events go "
+            "through runtime_event(), protocol lines through the "
+            "loop's locked writer"
+        ),
+        allowed_files=frozenset({"utils/logging.py"}),
+    ),
+    Rule(
+        name="router-raw-print",
+        pattern=re.compile(r"(?<![\w.])print\("),
+        why=(
+            "the router/worker processes OWN stdout as the JSONL wire "
+            "— a stray print corrupts the protocol and bypasses the "
+            "locked sink; use runtime_event() (events) or the loop's "
+            "locked emit (protocol lines)"
+        ),
+        allowed_files=frozenset({"router/cli.py"}),
+        only_under="router/",
     ),
 )
 
@@ -111,6 +137,8 @@ def scan_file(path: pathlib.Path, rel: str) -> list[Violation]:
         return out
     for rule in RULES:
         if rel in rule.allowed_files:
+            continue
+        if rule.only_under is not None and not rel.startswith(rule.only_under):
             continue
         for i, line in enumerate(lines, 1):
             if _COMMENT.match(line):

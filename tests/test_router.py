@@ -1,0 +1,735 @@
+"""Horizontal serving tier: routing, failover, hedging, fencing, drain.
+
+The load-bearing guarantees (DESIGN.md §22):
+
+- routing is deterministic, balanced, and minimally disruptive on
+  member death (hash ring), or contiguous (range);
+- the protocol's new ``request_id``/``deadline_ms`` fields round-trip
+  and stay backward-compatible; expired budgets fail fast and clamp
+  retry policies;
+- a worker killed MID-BATCH loses nothing: its in-flight requests are
+  re-dispatched and every answer is bit-identical to the
+  single-process oracle;
+- a stalled worker is hedged around; the loser's late answer is
+  dropped by request-id dedup;
+- a replica that missed a delta broadcast is fenced from every
+  affected row until ordered catch-up brings its token to the head —
+  verified as a property over random delta/query interleavings;
+- graceful drain (SIGTERM or the in-band op) completes every accepted
+  request before exit, at the serve loop, the worker loop, and the
+  router.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from collections import Counter
+
+import numpy as np
+import pytest
+
+from distributed_pathsim_tpu.backends.base import create_backend
+from distributed_pathsim_tpu.data.delta import delta_from_records, with_headroom
+from distributed_pathsim_tpu.data.synthetic import synthetic_hin
+from distributed_pathsim_tpu.ops.metapath import compile_metapath
+from distributed_pathsim_tpu.resilience import Deadline, RetryPolicy, inject
+from distributed_pathsim_tpu.router import (
+    HashRing,
+    InprocTransport,
+    RangeRouter,
+    Router,
+    RouterConfig,
+    RouterShed,
+    WorkerRuntime,
+)
+from distributed_pathsim_tpu.serving import PathSimService, ServeConfig
+from distributed_pathsim_tpu.serving.protocol import handle_request, serve_loop
+
+
+@pytest.fixture(scope="module")
+def hin():
+    # headroom so protocol-level update ops can append without rebuild
+    return with_headroom(synthetic_hin(140, 230, 8, seed=11), 0.25)
+
+
+@pytest.fixture(scope="module")
+def metapath(hin):
+    return compile_metapath("APVPA", hin.schema)
+
+
+def _service(hin, metapath, **cfg):
+    cfg.setdefault("max_wait_ms", 1.0)
+    cfg.setdefault("warm", False)
+    return PathSimService(
+        create_backend("numpy", hin, metapath),
+        config=ServeConfig(**cfg),
+    )
+
+
+@pytest.fixture()
+def oracle(hin, metapath):
+    svc = _service(hin, metapath)
+    yield svc
+    svc.close()
+
+
+def _oracle_topk(oracle, row: int, k: int):
+    vals, idxs = oracle.topk_index(int(row), k)
+    return [
+        (oracle._ident(int(j))[0], float(v))
+        for v, j in zip(vals, idxs)
+        if np.isfinite(v)
+    ]
+
+
+def _got_topk(resp: dict):
+    return [(h["id"], h["score"]) for h in resp["result"]["topk"]]
+
+
+class _Fleet:
+    """N inproc workers + a router, torn down as one unit."""
+
+    def __init__(self, hin, metapath, n_workers: int, **router_cfg):
+        self.transports = {}
+        for i in range(n_workers):
+            wid = f"w{i}"
+            svc = _service(hin, metapath)
+            self.transports[wid] = InprocTransport(
+                wid, WorkerRuntime(svc, worker_id=wid)
+            )
+        router_cfg.setdefault("heartbeat_interval_s", 0.05)
+        router_cfg.setdefault("hedge_ms", None)  # opt in per test
+        self.router = Router(self.transports, RouterConfig(**router_cfg))
+        self.router.start()
+
+    def close(self):
+        self.router.close()
+        for t in self.transports.values():
+            t.runtime.service.close()
+
+
+@pytest.fixture()
+def fleet3(hin, metapath):
+    f = _Fleet(hin, metapath, 3)
+    yield f
+    f.close()
+
+
+# -- routing policies ------------------------------------------------------
+
+
+def test_hashring_deterministic_balanced_total():
+    ring = HashRing(["a", "b", "c"], vnodes=64)
+    again = HashRing(["c", "b", "a"], vnodes=64)  # order-independent
+    owners = Counter()
+    for row in range(3000):
+        pref = ring.preference(row)
+        assert sorted(pref) == ["a", "b", "c"]  # total order, no dupes
+        assert again.preference(row) == pref
+        owners[pref[0]] += 1
+    # balanced within a small constant factor at 64 vnodes
+    assert max(owners.values()) < 2.5 * min(owners.values())
+
+
+def test_hashring_minimal_disruption():
+    ring = HashRing(["a", "b", "c"], vnodes=64)
+    shrunk = ring.without("b")
+    for row in range(2000):
+        old = ring.owner(row)
+        if old != "b":
+            # keys not owned by the dead member NEVER move
+            assert shrunk.owner(row) == old
+        else:
+            # orphaned keys move to the old ring's next preference
+            assert shrunk.owner(row) == ring.preference(row)[1]
+
+
+def test_range_router_contiguous_and_total():
+    rr = RangeRouter(["a", "b", "c"], n_rows=300)
+    assert rr.owner(0) == "a" and rr.owner(150) == "b" and rr.owner(299) == "c"
+    # every row routed, owner changes exactly at range boundaries
+    owners = [rr.owner(r) for r in range(300)]
+    assert owners == sorted(owners)
+    for row in (0, 123, 299):
+        assert sorted(rr.preference(row)) == ["a", "b", "c"]
+    # label keys are total too
+    assert rr.owner("some label") in ("a", "b", "c")
+
+
+# -- protocol: request_id, deadline_ms, health (satellite) -----------------
+
+
+def test_protocol_request_id_roundtrip(hin, metapath, oracle):
+    svc = _service(hin, metapath)
+    try:
+        resp = handle_request(
+            svc, {"id": 7, "op": "topk", "row": 3, "k": 4,
+                  "request_id": "r-abc", "deadline_ms": 30000.0},
+        )
+        assert resp["ok"] and resp["request_id"] == "r-abc"
+        assert _got_topk(resp) == _oracle_topk(oracle, 3, 4)
+        # backward compatible: absent fields never appear in responses
+        legacy = handle_request(svc, {"id": 8, "op": "topk", "row": 3})
+        assert legacy["ok"] and "request_id" not in legacy
+    finally:
+        svc.close()
+
+
+def test_protocol_deadline_expired_fails_fast(hin, metapath):
+    svc = _service(hin, metapath)
+    try:
+        resp = handle_request(
+            svc, {"id": 1, "op": "topk", "row": 0, "deadline_ms": -1.0},
+        )
+        assert not resp["ok"] and resp["deadline_exceeded"]
+        # errors echo the request identity too
+        resp = handle_request(
+            svc, {"id": 2, "op": "topk", "row": 0, "deadline_ms": 0.0,
+                  "request_id": "rX"},
+        )
+        assert not resp["ok"] and resp["request_id"] == "rX"
+    finally:
+        svc.close()
+
+
+def test_protocol_health_op(hin, metapath):
+    svc = _service(hin, metapath)
+    try:
+        resp = handle_request(svc, {"id": 1, "op": "health"})
+        h = resp["result"]
+        assert h["n"] == svc.n
+        assert h["base_fp"] == svc.consistency_token[0]
+        assert h["delta_seq"] == 0
+        assert h["queue_depth"] == 0 and "compiles" in h
+    finally:
+        svc.close()
+
+
+def test_deadline_clamps_retry_policy():
+    d = Deadline(0.5)
+    p = RetryPolicy(deadline_s=60.0)
+    assert d.clamp(p).deadline_s <= 0.5
+    tight = RetryPolicy(deadline_s=0.01)
+    assert d.clamp(tight).deadline_s == 0.01  # tighter of the two wins
+    assert Deadline.from_ms(None) is None
+    assert Deadline.from_ms(-5).expired
+
+
+def test_deadline_bounds_retry_wall_time():
+    """Retries under a clamped policy never overshoot the caller's
+    budget: the seam gives up instead of sleeping past the deadline."""
+    calls = [0]
+
+    def always_fails():
+        calls[0] += 1
+        raise inject.InjectedFault("flaky")
+
+    policy = Deadline(0.05).clamp(
+        RetryPolicy(max_attempts=50, base_delay=0.02, jitter=0.0)
+    )
+    t0 = time.monotonic()
+    with pytest.raises(inject.InjectedFault):
+        policy.call(always_fails, seam="test")
+    assert time.monotonic() - t0 < 0.5
+    assert calls[0] < 50  # gave up on the deadline, not on attempts
+
+
+# -- serve-loop graceful drain (satellite) ---------------------------------
+
+
+def test_serve_loop_graceful_drain(hin, metapath):
+    """SIGTERM (latched via the preemption handler) after request N:
+    requests 1..N all answered, the loop exits 0, nothing dropped."""
+    from distributed_pathsim_tpu.resilience import preemption_handler
+
+    svc = _service(hin, metapath)
+    out = io.StringIO()
+
+    def lines():
+        for i in range(3):
+            yield json.dumps({"id": i, "op": "topk", "row": i, "k": 3}) + "\n"
+        preemption_handler.request("test drain")
+        # the drain is latched: this line is read but never accepted
+        yield json.dumps({"id": 99, "op": "topk", "row": 5}) + "\n"
+        raise AssertionError("loop read past the drain point")
+
+    try:
+        rc = serve_loop(svc, lines(), out)
+    finally:
+        preemption_handler.reset()
+        svc.close()
+    assert rc == 0
+    resps = [json.loads(ln) for ln in out.getvalue().splitlines()]
+    assert [r["id"] for r in resps] == [0, 1, 2]
+    assert all(r["ok"] for r in resps)
+
+
+def test_serve_loop_drain_op(hin, metapath):
+    svc = _service(hin, metapath)
+    out = io.StringIO()
+    stream = io.StringIO(
+        json.dumps({"id": 1, "op": "topk", "row": 2}) + "\n"
+        + json.dumps({"id": 2, "op": "drain"}) + "\n"
+        + json.dumps({"id": 3, "op": "topk", "row": 4}) + "\n"
+    )
+    try:
+        rc = serve_loop(svc, stream, out)
+    finally:
+        svc.close()
+    assert rc == 0
+    resps = [json.loads(ln) for ln in out.getvalue().splitlines()]
+    assert [r["id"] for r in resps] == [1, 2]
+    assert resps[1]["result"]["draining"]
+
+
+# -- worker runtime: async completion, dedup, drain ------------------------
+
+
+def _collector():
+    got: list[dict] = []
+    done = threading.Event()
+
+    def reply(obj: dict) -> None:
+        got.append(obj)
+        done.set()
+
+    return got, done, reply
+
+
+def test_worker_runtime_async_topk(hin, metapath, oracle):
+    svc = _service(hin, metapath)
+    rt = WorkerRuntime(svc, worker_id="wA")
+    got, done, reply = _collector()
+    try:
+        assert rt.handle(
+            {"id": 5, "op": "topk", "row": 9, "k": 4, "request_id": "q1"},
+            reply,
+        ) == "ok"
+        assert done.wait(10)
+        resp = got[0]
+        assert resp["ok"] and resp["request_id"] == "q1"
+        assert _got_topk(resp) == _oracle_topk(oracle, 9, 4)
+        assert rt.inflight == 0
+    finally:
+        svc.close()
+
+
+def test_worker_runtime_update_dedup(hin, metapath):
+    """The idempotency contract: a re-delivered update (same
+    request_id) replays the cached ack; the delta applies ONCE."""
+    svc = _service(hin, metapath)
+    rt = WorkerRuntime(svc, worker_id="wB")
+    upd = {
+        "id": 1, "op": "update", "request_id": "u1",
+        "add_edges": [{"rel": "author_of", "src_row": 3, "dst_row": 7}],
+    }
+    try:
+        got1: list[dict] = []
+        rt.handle(dict(upd), got1.append)
+        assert got1[0]["ok"] and got1[0]["result"]["delta_seq"] == 1
+        got2: list[dict] = []
+        rt.handle({**upd, "id": 2}, got2.append)
+        assert got2[0]["ok"] and got2[0]["deduped"]
+        assert got2[0]["id"] == 2  # cached body, caller's envelope id
+        assert rt.dedup_hits == 1
+        assert svc.consistency_token[1] == 1  # applied exactly once
+        # a DIFFERENT request_id applies again
+        rt.handle(
+            {"id": 3, "op": "update", "request_id": "u2",
+             "remove_edges": [
+                 {"rel": "author_of", "src_row": 3, "dst_row": 7}
+             ]},
+            [].append,
+        )
+        assert svc.consistency_token[1] == 2
+    finally:
+        svc.close()
+
+
+def test_worker_runtime_drain_rejects_new_completes_inflight(hin, metapath):
+    svc = _service(hin, metapath, max_wait_ms=40.0, max_batch=4)
+    rt = WorkerRuntime(svc, worker_id="wC")
+    got, done, reply = _collector()
+    try:
+        # in flight: sits in the coalescer's straggler window
+        rt.handle({"id": 1, "op": "topk", "row": 2, "k": 3}, reply)
+        rt.begin_drain("test")
+        rejected: list[dict] = []
+        rt.handle({"id": 2, "op": "topk", "row": 3, "k": 3},
+                  rejected.append)
+        assert not rejected[0]["ok"] and rejected[0]["draining"]
+        assert rt.wait_idle(timeout=10)   # the accepted request finished
+        assert done.wait(1) and got[0]["ok"]
+    finally:
+        svc.close()
+
+
+# -- router: affinity, failover, hedging, shed, deadline -------------------
+
+
+def test_router_affinity_and_oracle_parity(fleet3, oracle):
+    """Every row's queries keep landing on one worker (cache
+    affinity), and routed answers equal the single-process oracle."""
+    sent: list[tuple[str, int]] = []
+    for wid, t in fleet3.transports.items():
+        orig = t.send
+
+        def spy(obj, _orig=orig, _wid=wid):
+            if obj.get("op") == "topk":
+                sent.append((_wid, obj["row"]))
+            _orig(obj)
+
+        t.send = spy
+    rows = [3, 77, 130, 3, 77, 130, 3]
+    for i, row in enumerate(rows):
+        resp = fleet3.router.request(
+            {"id": i, "op": "topk", "row": row, "k": 5}, timeout=20
+        )
+        assert resp["ok"]
+        assert _got_topk(resp) == _oracle_topk(oracle, row, 5)
+    by_row: dict[int, set] = {}
+    for wid, row in sent:
+        by_row.setdefault(row, set()).add(wid)
+    assert all(len(wids) == 1 for wids in by_row.values())
+
+
+def test_router_kill_mid_batch_zero_lost(hin, metapath, oracle):
+    """The headline chaos property: SIGKILL one replica while a batch
+    is in flight — every admitted request still answers, bit-identical
+    to the oracle."""
+    f = _Fleet(hin, metapath, 3)
+    try:
+        futs = [
+            f.router.submit({"id": i, "op": "topk",
+                             "row": int(i % oracle.n), "k": 5})
+            for i in range(60)
+        ]
+        f.transports["w1"].kill()
+        resps = [fut.result(timeout=30) for fut in futs]
+        assert all(r["ok"] for r in resps)
+        for i, r in enumerate(resps):
+            assert _got_topk(r) == _oracle_topk(oracle, i % oracle.n, 5)
+        st = f.router.stats()["router"]["workers"]
+        assert st["w1"]["status"] == "down"
+        assert sum(1 for r in resps if r.get("failovers")) > 0
+    finally:
+        f.close()
+
+
+def test_router_hedges_stalled_worker(hin, metapath, oracle):
+    """A stalled (not dead) replica: the hedge races a duplicate on
+    the next replica and the first answer wins; the stalled one's late
+    answer is dropped by dedup."""
+    f = _Fleet(hin, metapath, 2, hedge_ms=40.0)
+    try:
+        row = 17
+        owner = f.router.policy.owner(row)
+        # stall exactly the owner's NEXT dispatch for 1.2s
+        inject.install_plan("worker_dispatch:delay:1:1.2")
+        t0 = time.monotonic()
+        resp = f.router.request(
+            {"id": 1, "op": "topk", "row": row, "k": 5}, timeout=20
+        )
+        elapsed = time.monotonic() - t0
+        assert resp["ok"] and resp.get("hedged")
+        assert _got_topk(resp) == _oracle_topk(oracle, row, 5)
+        assert elapsed < 1.0, "hedge should beat the 1.2s stall"
+        assert owner in f.router.workers  # the stalled owner survives
+    finally:
+        inject.reset()
+        f.close()
+
+
+def test_router_sheds_when_all_saturated(hin, metapath):
+    f = _Fleet(hin, metapath, 2, worker_queue_limit=0)
+    try:
+        resp = f.router.request(
+            {"id": 1, "op": "topk", "row": 4, "k": 3}, timeout=10
+        )
+        assert not resp["ok"] and resp["shed"]
+    finally:
+        f.close()
+
+
+def test_router_admission_bound_sheds(hin, metapath):
+    f = _Fleet(hin, metapath, 2, max_inflight=0)
+    try:
+        with pytest.raises(RouterShed):
+            f.router.submit({"id": 1, "op": "topk", "row": 4})
+    finally:
+        f.close()
+
+
+def test_router_deadline_exceeded(fleet3):
+    resp = fleet3.router.request(
+        {"id": 1, "op": "topk", "row": 4, "deadline_ms": -1.0}, timeout=10
+    )
+    assert not resp["ok"] and resp["deadline_exceeded"]
+
+
+def test_router_startup_rejects_divergent_graphs(hin, metapath):
+    other = with_headroom(synthetic_hin(150, 230, 8, seed=99), 0.25)
+    mp2 = compile_metapath("APVPA", other.schema)
+    transports = {
+        "w0": InprocTransport(
+            "w0", WorkerRuntime(_service(hin, metapath), worker_id="w0")
+        ),
+        "w1": InprocTransport(
+            "w1", WorkerRuntime(_service(other, mp2), worker_id="w1")
+        ),
+    }
+    router = Router(transports, RouterConfig())
+    try:
+        with pytest.raises(ValueError, match="disagree on the base graph"):
+            router.start()
+    finally:
+        router.close()
+        for t in transports.values():
+            t.runtime.service.close()
+
+
+# -- delta broadcast, fencing, catch-up (satellite property test) ----------
+
+
+def _apply_update_to_oracle(oracle, upd: dict) -> None:
+    oracle.update(delta_from_records(
+        oracle.hin,
+        add_nodes=upd.get("add_nodes", ()),
+        add_edges=upd.get("add_edges", ()),
+        remove_edges=upd.get("remove_edges", ()),
+    ))
+
+
+def test_router_update_broadcast_all_ack(hin, metapath, oracle):
+    f = _Fleet(hin, metapath, 2)
+    try:
+        upd = {"id": 9, "op": "update",
+               "add_edges": [{"rel": "author_of", "src_row": 2,
+                              "dst_row": 5}]}
+        resp = f.router.request(dict(upd), timeout=30)
+        assert resp["ok"]
+        assert sorted(resp["result"]["applied"]) == ["w0", "w1"]
+        assert resp["result"]["lagging"] == []
+        assert resp["result"]["delta_seq"] == 1
+        _apply_update_to_oracle(oracle, upd)
+        # served answers reflect the delta on every replica
+        for row in (2, 40):
+            r = f.router.request(
+                {"id": 1, "op": "topk", "row": row, "k": 5}, timeout=20
+            )
+            assert _got_topk(r) == _oracle_topk(oracle, row, 5)
+        st = f.router.stats()["router"]
+        assert st["epochs"] == 2
+        assert all(w["lag"] == 0 for w in st["workers"].values())
+    finally:
+        f.close()
+
+
+def test_router_fencing_property(hin, metapath, oracle):
+    """The consistency property (acceptance criterion): over random
+    rounds of (update with one replica missing the broadcast) →
+    (queries), the lagging replica is NEVER handed a query for an
+    affected row until caught up, and every response is bit-identical
+    to a single-process oracle absorbing the same deltas."""
+    # heartbeats off: catch-up happens only when the test triggers it,
+    # so the fencing window is deterministic and spans the assertions
+    f = _Fleet(hin, metapath, 2, heartbeat_interval_s=3600.0)
+    rng = np.random.default_rng(5)
+    router = f.router
+    dispatched: list[tuple[str, int]] = []
+    for wid, t in f.transports.items():
+        orig = t.send
+
+        def spy(obj, _orig=orig, _wid=wid):
+            if obj.get("op") == "topk":
+                dispatched.append((_wid, obj["row"]))
+            _orig(obj)
+
+        t.send = spy
+    try:
+        n = oracle.n
+        for round_i in range(4):
+            victim = f"w{round_i % 2}"
+            # the victim misses this broadcast: fire the seam only on
+            # its send (workers iterate in insertion order w0, w1)
+            skip = 0 if victim == "w0" else 1
+            inject.install_plan(f"delta_broadcast:error:1@{skip}")
+            # a genuinely new edge: an add colliding with an existing
+            # one is a malformed batch the delta machinery rejects
+            ap = oracle.hin.blocks["author_of"]
+            existing = set(zip(ap.rows.tolist(), ap.cols.tolist()))
+            while True:
+                src = int(rng.integers(0, 140))
+                dst = int(rng.integers(0, 230))
+                if (src, dst) not in existing:
+                    break
+            upd = {"id": round_i, "op": "update",
+                   "add_edges": [{"rel": "author_of", "src_row": src,
+                                  "dst_row": dst}]}
+            resp = router.request(dict(upd), timeout=30)
+            inject.reset()
+            assert resp["ok"] and resp["result"]["lagging"] == [victim]
+            _apply_update_to_oracle(oracle, upd)
+            affected = router._epochs[-1].affected
+            assert affected, "delta must affect at least the source row"
+            dispatched.clear()
+            # queries while the victim lags: mix affected + unaffected
+            rows = list(affected)[:6] + [
+                int(r) for r in rng.integers(0, n, size=6)
+            ]
+            for row in rows:
+                r = router.request(
+                    {"id": 1, "op": "topk", "row": int(row), "k": 5},
+                    timeout=20,
+                )
+                assert r["ok"]
+                assert _got_topk(r) == _oracle_topk(oracle, int(row), 5)
+            # THE fence: no affected row ever reached the laggard
+            for wid, row in dispatched:
+                if wid == victim:
+                    assert row not in affected, (
+                        f"fenced row {row} dispatched to lagging {victim}"
+                    )
+            # catch-up: one health round-trip triggers the ordered
+            # replay; the worker's token reaches the head
+            assert router.worker_health(victim, timeout=10)
+            for _ in range(200):
+                st = router.stats()["router"]["workers"][victim]
+                if st["lag"] == 0:
+                    break
+                time.sleep(0.01)
+            else:
+                raise AssertionError(f"{victim} never caught up")
+            # post-catch-up: the ex-laggard answers affected rows
+            # correctly (route around is gone)
+            dispatched.clear()
+            for row in list(affected)[:4]:
+                r = router.request(
+                    {"id": 1, "op": "topk", "row": int(row), "k": 5},
+                    timeout=20,
+                )
+                assert _got_topk(r) == _oracle_topk(oracle, int(row), 5)
+        # dedup saw no double-applies: each replica's delta_seq equals
+        # the number of broadcasts
+        for t in f.transports.values():
+            assert t.runtime.service.consistency_token[1] == 4
+        # epoch-log compaction: every replica has passed epochs 1..4,
+        # so their replay payloads must be gone (a long-lived router
+        # must not retain every delta's edge lists forever)
+        assert router._compacted_to == 5
+        assert all(e.wire_req is None for e in router._epochs[1:5])
+    finally:
+        inject.reset()
+        f.close()
+
+
+# -- chaos: the ambient-plan smoke (make chaos-router) ---------------------
+
+
+@pytest.mark.chaos
+def test_chaos_router_smoke(hin, metapath, oracle):
+    """The router under an ambient fault plan + a mid-batch kill:
+    transient dispatch failures, dropped heartbeats, a missed delta
+    broadcast, a stall — zero lost requests, every answer bit-exact.
+    ``make chaos-router`` re-runs this with the plan in the
+    environment; here it is installed explicitly so plain tier-1
+    exercises it too."""
+    plan = os.environ.get("PATHSIM_FAULT_PLAN") or ",".join([
+        "worker_dispatch:error:3",
+        "worker_dispatch:delay:1:0.05",
+        "heartbeat:error:2",
+        "delta_broadcast:error:1@1",
+    ])
+    inject.install_plan(plan)
+    f = _Fleet(hin, metapath, 3, hedge_ms=80.0)
+    try:
+        futs = [
+            f.router.submit({"id": i, "op": "topk",
+                             "row": int(i % oracle.n), "k": 5})
+            for i in range(40)
+        ]
+        upd = {"id": 100, "op": "update",
+               "add_edges": [{"rel": "author_of", "src_row": 8,
+                              "dst_row": 12}]}
+        uresp = f.router.request(dict(upd), timeout=30)
+        assert uresp["ok"]
+        _apply_update_to_oracle(oracle, upd)
+        f.transports["w2"].kill()  # and THEN a worker dies
+        resps = [fut.result(timeout=30) for fut in futs]
+        assert all(r["ok"] for r in resps), [
+            r for r in resps if not r["ok"]
+        ][:3]
+        # post-delta, post-kill queries: still oracle-exact
+        for row in (8, 50, 100):
+            r = f.router.request(
+                {"id": 1, "op": "topk", "row": row, "k": 5}, timeout=30
+            )
+            assert r["ok"] and _got_topk(r) == _oracle_topk(oracle, row, 5)
+    finally:
+        inject.reset()
+        f.close()
+
+
+# -- worker process: SIGTERM drain + the full smoke (make router-smoke) ----
+
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_worker_subprocess_sigterm_drain():
+    """A real ``dpathsim worker`` process: SIGTERM mid-stream → every
+    accepted request answered, drained event emitted, exit 0."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "distributed_pathsim_tpu.cli", "worker",
+         "--dataset", "synthetic:authors=48,papers=80,venues=4,seed=2",
+         "--backend", "numpy", "--no-warm", "--worker-id", "wS"],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, text=True, env=env, cwd=REPO,
+    )
+    try:
+        ready = json.loads(proc.stdout.readline())
+        assert ready["event"] == "ready"
+        for i in range(3):
+            proc.stdin.write(json.dumps(
+                {"id": i, "op": "topk", "row": i, "k": 3}
+            ) + "\n")
+        proc.stdin.flush()
+        got = [json.loads(proc.stdout.readline()) for _ in range(3)]
+        assert all(r["ok"] for r in got)
+        proc.send_signal(signal.SIGTERM)
+        time.sleep(0.2)  # let the signal latch before the next event
+        proc.stdin.write(json.dumps({"id": 9, "op": "topk", "row": 1}) + "\n")
+        proc.stdin.flush()
+        tail = [json.loads(ln) for ln in proc.stdout]
+        assert proc.wait(timeout=30) == 0
+        # the post-signal line was never accepted; the drained event is
+        # the last thing out
+        assert not any(r.get("id") == 9 for r in tail)
+        assert any(r.get("event") == "drained" for r in tail)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        proc.wait()
+
+
+def test_bench_router_smoke():
+    """``make router-smoke`` as a tier-1 test: 2 real worker
+    subprocesses, closed-loop load, a mid-load SIGKILL; gates zero
+    lost requests, zero steady-state recompiles, oracle bit-parity,
+    and a real rerouted failover."""
+    sys.path.insert(0, REPO)
+    try:
+        import bench_serving
+
+        result = bench_serving.run_router_smoke()
+    finally:
+        sys.path.remove(REPO)
+    assert all(result["smoke_checks"].values()), result["smoke_checks"]
