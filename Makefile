@@ -4,7 +4,7 @@
 PYTHON ?= python
 
 .PHONY: test chaos chaos-router serve-smoke update-smoke obs-smoke \
-	router-smoke lint-telemetry tune-smoke lint-tuning tune
+	router-smoke ann-smoke lint-telemetry tune-smoke lint-tuning tune
 
 # Tier-1: the fast CPU suite (the driver's acceptance gate).
 test:
@@ -52,6 +52,18 @@ serve-smoke:
 update-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) bench_serving.py --regime update --smoke \
 		--out BENCH_SERVING_UPDATE_r07.json
+
+# ANN smoke: build a small MIPS index, serve mixed exact/ann
+# closed-loop load. Hard gates: recall@10 >= 0.99 at the shipped
+# default knobs, zero steady-state XLA recompiles (probe buckets
+# pre-warmed like the exact buckets), the delta-staleness fallback
+# exercised (stale row answered exactly, never from a stale index;
+# refresh restores ann), zero shed. The >=3x QPS claim is the
+# full-size artifact's (BENCH_ANN_r11.json, >=32k authors). The same
+# run is wired as a non-slow pytest
+# (tests/test_index.py::test_bench_ann_smoke), so tier-1 covers it.
+ann-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) bench_serving.py --regime ann --smoke
 
 # Observability smoke: four arms (off / metrics / sampled tracing /
 # full tracing) interleaved on the same steady-state workload, with

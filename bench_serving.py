@@ -51,9 +51,12 @@ def _percentiles(lat_s: list[float]) -> dict:
     }
 
 
-def _run_clients(service, schedule: list[list[int]], k: int) -> dict:
+def _run_clients(service, schedule: list[list[int]], k: int,
+                 mode=None) -> dict:
     """Closed-loop: client c issues schedule[c] row queries back to
-    back. Returns QPS + latency percentiles + shed count."""
+    back. Returns QPS + latency percentiles + shed count. ``mode``:
+    None → the service default; a string → every query; "mixed" →
+    alternating ann/exact per query (the ann regime's mixed arm)."""
     from distributed_pathsim_tpu.serving import LoadShedError
 
     lats: list[list[float]] = [[] for _ in schedule]
@@ -62,10 +65,13 @@ def _run_clients(service, schedule: list[list[int]], k: int) -> dict:
 
     def client(ci: int, rows: list[int]) -> None:
         barrier.wait()
-        for r in rows:
+        for j, r in enumerate(rows):
+            m = mode
+            if mode == "mixed":
+                m = "ann" if j % 2 else "exact"
             t0 = time.perf_counter()
             try:
-                service.topk_index(int(r), k=k)
+                service.topk_index(int(r), k=k, mode=m)
             except LoadShedError:
                 shed[0] += 1
                 continue
@@ -93,7 +99,7 @@ def _run_clients(service, schedule: list[list[int]], k: int) -> dict:
 
 
 def _build_service(hin, backend_name, max_batch, max_wait_ms, caches,
-                   queue_depth=4096, warm=True, k=10):
+                   queue_depth=4096, warm=True, k=10, **extra_cfg):
     from distributed_pathsim_tpu.backends.base import create_backend
     from distributed_pathsim_tpu.ops.metapath import compile_metapath
     from distributed_pathsim_tpu.serving import PathSimService, ServeConfig
@@ -110,6 +116,7 @@ def _build_service(hin, backend_name, max_batch, max_wait_ms, caches,
             tile_cache_bytes=(64 << 20) if caches else 0,
             k_default=k,
             warm=warm,
+            **extra_cfg,
         ),
     )
 
@@ -985,6 +992,341 @@ def run_router_smoke(out_path: str | None = None) -> dict:
     return result
 
 
+def _ann_recall_audit(ann_svc, exact_svc, rows, k: int) -> dict:
+    """Measured recall@k + bit-parity of the ANN path vs the exact
+    oracle over ``rows``. Two recall readings:
+
+    - ``recall_at_k`` (the gate) is SCORE recall: a returned item
+      whose exact f64 score ≥ the oracle's k-th score is a hit. On
+      integer-count graphs the k boundary routinely sits inside a
+      large exactly-tied set, and id-recall would punish returning a
+      tie member the oracle only rejects by its arbitrary
+      ascending-column convention; ann scores are exact, so the score
+      comparison is bit-meaningful.
+    - ``id_recall_at_k`` (reported) is the strict index-set overlap.
+
+    ``bit_identical`` additionally requires identical f64 values AND
+    tie order — the acceptance contract whenever the true top-k is
+    inside the candidate set."""
+    import numpy as np
+
+    recalls, id_recalls = [], []
+    bit_identical = 0
+    for row in rows:
+        av, ai = ann_svc.topk_index(int(row), k=k, mode="ann")
+        ev, ei = exact_svc.topk_index(int(row), k=k, mode="exact")
+        want = [int(i) for i, v in zip(ei, ev) if np.isfinite(v)]
+        got = {int(i) for i, v in zip(ai, av) if np.isfinite(v)}
+        if want:
+            id_recalls.append(
+                sum(1 for i in want if i in got) / len(want)
+            )
+            kth = min(v for v in ev if np.isfinite(v))
+            got_v = av[np.isfinite(av)]
+            recalls.append(
+                min(float((got_v >= kth).sum()) / len(want), 1.0)
+            )
+        if np.array_equal(ai, ei) and np.array_equal(av, ev):
+            bit_identical += 1
+    return {
+        "samples": len(rows),
+        "recall_at_k": round(float(np.mean(recalls)), 6),
+        "min_recall": round(float(np.min(recalls)), 6),
+        "id_recall_at_k": round(float(np.mean(id_recalls)), 6),
+        "bit_identical": bit_identical,
+        "bit_identical_frac": round(bit_identical / max(len(rows), 1), 6),
+    }
+
+
+def run_ann_bench(
+    n_authors: int = 32768,
+    n_papers: int = 65536,
+    n_venues: int = 64,
+    clients: int = 16,
+    queries_per_client: int = 64,
+    max_batch: int = 32,
+    max_wait_ms: float = 1.0,
+    reps: int = 3,
+    k: int = 10,
+    backend: str = "jax",
+    seed: int = 0,
+    oracle_samples: int = 128,
+    exercise_staleness: bool = True,
+) -> dict:
+    """Closed-loop exact-vs-ann arms on one graph (ISSUE 8 satellite):
+
+    - **exact** — the pre-index path: every query scores a full O(N)
+      row (caches off, so the arm measures the dispatch path, not the
+      working set);
+    - **ann** — candidate generation (index probe = one batched
+      matmul) + exact f64 rerank of C = cand_mult·k candidates;
+    - **mixed** — alternating exact/ann per query on the ann service
+      (both lanes through one coalescer, the production posture).
+
+    Arms are interleaved per round on the shared estimator
+    (utils/benchrunner.py) so box drift taxes them equally. The
+    artifact also records measured recall@k + bit-parity vs the exact
+    oracle, steady-state XLA compile counts (must be 0 — the probe is
+    warmed per bucket exactly like the exact path), and a
+    staleness/fallback exercise (delta → stale row answers exactly →
+    refresh → ann again)."""
+    from distributed_pathsim_tpu.data.synthetic import synthetic_hin
+    from distributed_pathsim_tpu.utils import benchrunner as br
+    from distributed_pathsim_tpu.utils.xla_flags import CompileCounter
+
+    hin = synthetic_hin(n_authors, n_papers, n_venues, seed=seed)
+    rng = np.random.default_rng(seed)
+    n = hin.type_size("author")
+
+    exact_svc = _build_service(hin, backend, max_batch=max_batch,
+                               max_wait_ms=max_wait_ms, caches=False, k=k)
+    ann_svc = _build_service(hin, backend, max_batch=max_batch,
+                             max_wait_ms=max_wait_ms, caches=False, k=k,
+                             topk_mode="ann", ann_shadow_every=0)
+    ann_snapshot = ann_svc.stats()["ann"]
+    # Query population: degree>0 authors. The synthetic Zipf tail
+    # leaves a large fraction of authors with no papers at all; those
+    # rows answer through the exact path BY DESIGN (the 'degenerate'
+    # fallback — their whole score row is zero), so leaving them in
+    # the schedule would silently turn the ann arm into a mixed arm.
+    # The fallback machinery is exercised explicitly below instead.
+    eligible = np.flatnonzero(ann_svc._d > 0)
+    try:
+        def one_round(svc, mode, cl):
+            sched = rng.choice(
+                eligible, size=(cl, queries_per_client)
+            )
+            return _run_clients(svc, sched.tolist(), k, mode=mode)
+
+        # Concurrency sweep per arm: "≥ X× QPS at equal p99" is a
+        # load-curve comparison — each arm runs at several closed-loop
+        # client counts, and the headline compares the best QPS each
+        # path reaches without exceeding the other's p99 SLO. (At high
+        # batch occupancy the exact path amortizes its O(N) scan over
+        # the whole coalesced batch — one dense GEMM for 32 queries —
+        # which is a real effect the sweep shows rather than hides.)
+        sweep = tuple(
+            sorted({
+                c for c in (clients, 2 * clients, 4 * clients,
+                            8 * clients, 16 * clients, 32 * clients)
+                if 1 <= c <= max(64, clients)
+            })
+        )
+        arms_fns = {}
+        for cl in sweep:
+            arms_fns[f"exact_c{cl}"] = (
+                lambda cl=cl: one_round(exact_svc, "exact", cl)
+            )
+            arms_fns[f"ann_c{cl}"] = (
+                lambda cl=cl: one_round(ann_svc, "ann", cl)
+            )
+        arms_fns[f"mixed_c{clients}"] = (
+            lambda: one_round(ann_svc, "mixed", clients)
+        )
+        # warm every arm once (compiles, allocator), then measure with
+        # the compile ledger open: steady state must add nothing
+        for fn in arms_fns.values():
+            fn()
+        with CompileCounter() as cc:
+            runs = br.interleave(arms_fns, reps)
+        compiles = cc.count
+
+        med = br.median
+        arms_out = {}
+        for name, rs in runs.items():
+            arms_out[name] = {
+                "qps_median": med([r["qps"] for r in rs]),
+                "qps_best": max(r["qps"] for r in rs),
+                "p50_ms_median": med([r["p50_ms"] for r in rs]),
+                "p99_ms_median": med([r["p99_ms"] for r in rs]),
+                "shed": sum(r["shed"] for r in rs),
+                "runs": rs,
+            }
+        sample_rows = rng.choice(
+            eligible, size=min(oracle_samples, eligible.size),
+            replace=False,
+        )
+        recall = _ann_recall_audit(ann_svc, exact_svc, sample_rows, k)
+        fallbacks = None
+        if exercise_staleness:
+            fallbacks = _ann_staleness_exercise(hin, backend, k,
+                                                max_wait_ms, seed)
+        out = {
+            "graph": {"authors": n, "papers": n_papers,
+                      "venues": n_venues, "seed": seed},
+            "load": {"clients": clients,
+                     "queries_per_client": queries_per_client,
+                     "k": k, "max_batch": max_batch,
+                     "max_wait_ms": max_wait_ms, "reps": reps,
+                     "eligible_rows": int(eligible.size),
+                     "row_population": "degree>0 authors (zero-degree "
+                     "rows answer exactly by design — the 'degenerate' "
+                     "fallback — and are exercised separately)"},
+            "backend": backend,
+            "index": ann_snapshot,
+            "arms": arms_out,
+            "speedups": _ann_speedups(arms_out, clients, sweep),
+            "recall": recall,
+            "steady_state_compiles": compiles,
+            "ann_service_stats": ann_svc.stats()["ann"],
+            "estimator_note": (
+                "arms interleaved per round (utils/benchrunner.py); "
+                "medians + best-window recorded. Recall/bit-parity and "
+                "compile counts are deterministic gates; QPS is the "
+                "box-dependent claim. Environment honesty: on this "
+                "2-core CPU box the exact arm amortizes its O(N) scan "
+                "over each coalesced batch as ONE BLAS GEMM, which "
+                "compresses the ann speedup at high occupancy (the "
+                "per-concurrency curves show it); the shipped default "
+                "knobs take the RECALL-SAFE point (nprobe clamp 96). "
+                "The asymptotic win belongs to low-occupancy latency "
+                "traffic here and to the TPU rerun (the 'shortlist' "
+                "MXU probe variant) for throughput."
+            ),
+        }
+        if fallbacks is not None:
+            out["staleness_exercise"] = fallbacks
+        return out
+    finally:
+        exact_svc.close()
+        ann_svc.close()
+
+
+def _ann_speedups(arms_out: dict, base_clients: int, sweep) -> dict:
+    """The headline comparisons from the concurrency sweep:
+
+    - ``ann_vs_exact_qps_same_concurrency``: both arms at the base
+      client count (the naive comparison);
+    - ``ann_vs_exact_qps_at_equal_p99``: exact's best-QPS sweep point
+      sets the p99 SLO; ann's best QPS among sweep points meeting that
+      SLO is the numerator — the load-curve comparison "X× the QPS at
+      equal p99" actually means."""
+    exact_pts = {
+        name: a for name, a in arms_out.items()
+        if name.startswith("exact_c")
+    }
+    ann_pts = {
+        name: a for name, a in arms_out.items()
+        if name.startswith("ann_c")
+    }
+    out: dict = {}
+    base_e = exact_pts.get(f"exact_c{base_clients}")
+    base_a = ann_pts.get(f"ann_c{base_clients}")
+    if base_e and base_a:
+        out["ann_vs_exact_qps_same_concurrency"] = round(
+            base_a["qps_median"] / base_e["qps_median"], 2
+        )
+    best_e = max(exact_pts.values(), key=lambda a: a["qps_median"])
+    slo = best_e["p99_ms_median"]
+    within = [
+        (name, a) for name, a in ann_pts.items()
+        if a["p99_ms_median"] <= slo
+    ]
+    if within:
+        name, best_a = max(within, key=lambda kv: kv[1]["qps_median"])
+        out["ann_vs_exact_qps_at_equal_p99"] = round(
+            best_a["qps_median"] / best_e["qps_median"], 2
+        )
+        out["equal_p99_detail"] = {
+            "exact_best_qps": best_e["qps_median"],
+            "exact_p99_ms_slo": slo,
+            "ann_point": name,
+            "ann_qps": best_a["qps_median"],
+            "ann_p99_ms": best_a["p99_ms_median"],
+        }
+    return out
+
+
+def _ann_staleness_exercise(hin, backend, k, max_wait_ms, seed) -> dict:
+    """The fallback path, exercised for real on a fresh warm service:
+    apply a delta (auto-refresh off) → the affected row must answer
+    through the exact path (counted fallback) and match the live
+    oracle bit-for-bit → refresh_index → the row answers via ann
+    again. Returns the ledger the smoke gates check."""
+    import numpy as np
+
+    from distributed_pathsim_tpu.data import delta as dl
+
+    hin2 = dl.with_headroom(hin, 0.25)
+    svc = _build_service(hin2, backend, max_batch=8,
+                         max_wait_ms=max_wait_ms, caches=False, k=k,
+                         topk_mode="ann", ann_shadow_every=0,
+                         ann_auto_refresh=False)
+    try:
+        ap = svc.hin.blocks["author_of"]
+        rng = np.random.default_rng(seed)
+        i = int(rng.integers(0, ap.nnz))
+        row = int(ap.rows[i])
+        delta = dl.DeltaBatch(edges=(dl.edge_delta(
+            "author_of", add=(),
+            remove=[(row, int(ap.cols[i]))],
+        ),))
+        info = svc.update(delta)
+        av, ai = svc.topk_index(row, k=k, mode="ann")   # stale → exact
+        ev, ei = svc.topk_index(row, k=k, mode="exact")
+        stale_exact = bool(
+            np.array_equal(ai, ei) and np.array_equal(av, ev)
+        )
+        fb = svc.stats()["ann"]
+        refresh = svc.refresh_index()
+        av2, ai2 = svc.topk_index(row, k=k, mode="ann")
+        return {
+            "update_mode": info["mode"],
+            "stale_rows_after_update": info.get("ann_stale_rows"),
+            "stale_row_answered_exactly": stale_exact,
+            "stale_rows_after_refresh": refresh["stale_remaining"],
+            "post_refresh_ann_matches": bool(np.array_equal(ai2, ei)),
+            "ann_state": fb,
+        }
+    finally:
+        svc.close()
+
+
+def run_ann_smoke(out_path: str | None = None) -> dict:
+    """The tier-1 ANN gate (``make ann-smoke``): build a small index,
+    serve a mixed exact/ann closed-loop load, and hard-gate what is
+    deterministic on shared hardware — recall@10 ≥ 0.99 at the shipped
+    default knobs, ZERO steady-state XLA recompiles (probe buckets are
+    pre-warmed like the exact buckets), the delta-staleness fallback
+    exercised for real (stale row answered exactly, never from the
+    stale index; refresh restores ann), and zero shed. The ≥3× QPS
+    claim belongs to the full-size artifact (BENCH_ANN_r11.json, ≥32k
+    authors) — a 2-core box running tiny graphs measures Python
+    overhead, not the O(N) vs O(C) asymptotic."""
+    result = run_ann_bench(
+        n_authors=768, n_papers=1280, n_venues=16,
+        clients=8, queries_per_client=24,
+        max_batch=8, max_wait_ms=1.0, reps=2, k=10,
+        oracle_samples=64,
+    )
+    st = result["staleness_exercise"]
+    checks = {
+        "recall_ge_0_99": result["recall"]["recall_at_k"] >= 0.99,
+        "zero_steady_state_compiles": (
+            result["steady_state_compiles"] == 0
+        ),
+        "stale_row_answered_exactly": (
+            st["update_mode"] == "delta"
+            and st["stale_rows_after_update"] > 0
+            and st["stale_row_answered_exactly"]
+        ),
+        "refresh_restores_ann": (
+            st["stale_rows_after_refresh"] == 0
+            and st["post_refresh_ann_matches"]
+        ),
+        "zero_shed": all(
+            a["shed"] == 0 for a in result["arms"].values()
+        ),
+    }
+    result["smoke_checks"] = checks
+    if out_path:
+        with open(out_path, "w", encoding="utf-8") as f:
+            json.dump(result, f, indent=2)
+    if not all(checks.values()):
+        raise AssertionError(f"ann smoke failed: {checks}")
+    return result
+
+
 def run_smoke(out_path: str | None = None) -> dict:
     """Small fixed-seed run with the two hard gates tier-1 enforces."""
     result = run_bench(
@@ -1014,12 +1356,14 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--smoke", action="store_true",
                    help="small fixed run with hard pass/fail gates")
     p.add_argument("--regime", default="load",
-                   choices=("load", "update", "obs", "router"),
+                   choices=("load", "update", "obs", "router", "ann"),
                    help="'load': the closed-loop QPS regimes; 'update': "
                    "delta-ingestion vs reload latency; 'obs': "
                    "observability overhead (obs on vs off, steady "
                    "state); 'router': multi-process QPS-vs-replicas "
-                   "curve + mid-load worker-kill failover")
+                   "curve + mid-load worker-kill failover; 'ann': "
+                   "exact-vs-ann closed-loop arms with measured "
+                   "recall@k vs the exact oracle (BENCH_ANN artifact)")
     p.add_argument("--replicas", default="1,2,4",
                    help="router regime: comma-separated worker counts")
     p.add_argument("--edge-frac", type=float, default=0.01,
@@ -1041,7 +1385,22 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--out", default=None, help="write the JSON here")
     args = p.parse_args(argv)
 
-    if args.regime == "router":
+    if args.regime == "ann":
+        if args.smoke:
+            result = run_ann_smoke(args.out)
+        else:
+            result = run_ann_bench(
+                n_authors=args.authors, n_papers=args.papers,
+                n_venues=args.venues, clients=args.clients,
+                queries_per_client=args.queries_per_client,
+                max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
+                reps=args.reps, k=args.k, backend=args.backend,
+                seed=args.seed,
+            )
+            if args.out:
+                with open(args.out, "w", encoding="utf-8") as f:
+                    json.dump(result, f, indent=2)
+    elif args.regime == "router":
         if args.smoke:
             result = run_router_smoke(args.out)
         else:

@@ -204,6 +204,17 @@ def main(argv: list[str] | None = None) -> int:
             msg = exc.args[0] if exc.args else exc
             print(f"error: {msg}", file=sys.stderr)
             return 1
+    if argv and argv[0] == "index":
+        # ``dpathsim index build/probe`` — MIPS candidate-generation
+        # index artifacts for `serve --topk-mode ann` (index/cli.py).
+        from .index.cli import index_main
+
+        try:
+            return index_main(argv[1:])
+        except (KeyError, ValueError, FileNotFoundError) as exc:
+            msg = exc.args[0] if exc.args else exc
+            print(f"error: {msg}", file=sys.stderr)
+            return 1
     if argv and argv[0] == "tune":
         # ``dpathsim tune`` — offline autotuner: measure every knob's
         # candidate arms on THIS device and write the dispatch table

@@ -1,0 +1,35 @@
+"""Sublinear top-k candidate generation: a TPU-friendly MIPS index.
+
+Exact PathSim serving scores a full O(N) row per query; at millions of
+authors a production service can't. This package puts a *candidate
+generation* tier in front of the exact engine (ROADMAP item 2, grounded
+in the Neural-PathSim inductive-index idea and Atrapos's workload
+framing): a k-means centroid-quantized inner-product index over the
+neural/analytic node embeddings, with the per-cluster embeddings packed
+into padded jit-stable blocks so a probe is ONE batched matmul — no
+gather-heavy IVF traversal — and the exact f64 scorer reranks the
+candidates, so the user-visible answer stays exact whenever the true
+top-k is inside the candidate set (tie order included).
+
+- :mod:`mips` — :class:`CentroidIndex`: build (k-means + capacity-
+  bounded packing), probe (batched, static shapes), per-row staleness
+  + in-place refresh, atomic save/load.
+- :mod:`build` — embedding maps (analytic Cauchy-quadrature map by
+  default; learned two-tower checkpoints as the compact alternative)
+  and the graph → index build pipeline.
+- :mod:`cli` — ``dpathsim index build`` / ``dpathsim index probe``.
+
+The serving integration (``--topk-mode ann``, exact fallback, shadow-
+recall confidence, delta staleness) lives in serving/service.py;
+DESIGN.md §23 has the full contract.
+"""
+
+from .build import build_index, struct_embeddings  # noqa: F401
+from .mips import CentroidIndex, IndexMismatch  # noqa: F401
+
+__all__ = [
+    "CentroidIndex",
+    "IndexMismatch",
+    "build_index",
+    "struct_embeddings",
+]

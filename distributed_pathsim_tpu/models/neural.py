@@ -49,6 +49,41 @@ from ..ops import sparse as sp
 from ..ops.metapath import MetaPath, compile_metapath
 
 
+def cauchy_quadrature(
+    d: np.ndarray, m: int = 12, margin: float = 2.0
+) -> tuple[np.ndarray, np.ndarray]:
+    """Log-spaced quadrature (nodes t, weights w) for the Cauchy kernel
+    identity 1/(d_i+d_j) = ∫₀^∞ e^(-t·d_i)·e^(-t·d_j) dt over the
+    observed denominator range: s = d_i + d_j ∈ [2·min d⁺, 2·max d],
+    extended by ``margin`` on each side in u = log t (the trapezoid
+    rule needs tail room for uniform relative accuracy). Shared by the
+    trainer's feature gates and the index/ subsystem's analytic
+    embedding map — one definition so the two can never drift."""
+    d = np.asarray(d, dtype=np.float64)
+    dpos = d[d > 0]
+    if not dpos.size:  # degenerate graph: every denominator is zero
+        return np.zeros(m), np.zeros(m)
+    s_lo = max(2.0 * float(dpos.min()), 1e-12)
+    s_hi = max(2.0 * float(dpos.max()), s_lo * (1.0 + 1e-9))
+    u = np.linspace(
+        np.log(1.0 / s_hi) - margin, np.log(1.0 / s_lo) + margin, m
+    )
+    h = float(u[1] - u[0]) if m > 1 else 1.0
+    t = np.exp(u)
+    return t, h * t
+
+
+def quadrature_gates(d: np.ndarray, t: np.ndarray) -> np.ndarray:
+    """Denominator gates E[j,k] = e^(-d_j·t_k) ∈ [0,1] (f32): the
+    complete quadrature picture of 1/(d_j + ·)."""
+    return np.exp(
+        -np.clip(
+            np.asarray(d, np.float64)[:, None] * np.asarray(t)[None, :],
+            0.0, 700.0,
+        )
+    ).astype(np.float32)
+
+
 class TwoTower(nn.Module):
     """Shared-weight encoder tower: features → embedding."""
 
@@ -151,35 +186,20 @@ class NeuralPathSim:
             self._d = self._c64 @ self._c64.sum(axis=0)  # rowsums of M
         else:  # diagonal: diag(M)[i] = Σ_v C[i,v]²
             self._d = np.einsum("nv,nv->n", self._c64, self._c64)
-        # Cauchy-quadrature nodes for the structural index: log-spaced
-        # over the observed range of s = d_i + d_j ∈ [2·min d⁺, 2·max d],
-        # extended by _QUAD_MARGIN on each side (the trapezoid rule on
-        # u = log t needs tail room for uniform relative accuracy).
-        dpos = self._d[self._d > 0]
+        # Cauchy-quadrature nodes for the structural index (module-level
+        # cauchy_quadrature — shared with index/build.py's embedding map).
         if quad is not None:
             self._quad_t = np.asarray(quad[0], dtype=np.float64)
             self._quad_w = np.asarray(quad[1], dtype=np.float64)
-        elif dpos.size:
-            s_lo = max(2.0 * float(dpos.min()), 1e-12)
-            s_hi = max(2.0 * float(dpos.max()), s_lo * (1.0 + 1e-9))
-            u = np.linspace(
-                np.log(1.0 / s_hi) - self._QUAD_MARGIN,
-                np.log(1.0 / s_lo) + self._QUAD_MARGIN,
-                self.QUAD_M,
+        else:
+            self._quad_t, self._quad_w = cauchy_quadrature(
+                self._d, m=self.QUAD_M, margin=self._QUAD_MARGIN
             )
-            h = float(u[1] - u[0]) if self.QUAD_M > 1 else 1.0
-            self._quad_t = np.exp(u)
-            self._quad_w = h * self._quad_t
-        else:  # degenerate graph: every row of C is zero
-            self._quad_t = np.zeros(self.QUAD_M)
-            self._quad_w = np.zeros(self.QUAD_M)
-        # Denominator gates E[j,k] = e^(-d_j·t_k) ∈ [0,1]: the complete
-        # quadrature picture of 1/(d_i + ·); also fed to the tower as
-        # well-scaled features (log1p(d) alone is a single number; the
-        # gates give the MLP the kernel the exact score actually uses).
-        self._gates = np.exp(
-            -np.clip(self._d[:, None] * self._quad_t[None, :], 0.0, 700.0)
-        ).astype(np.float32)
+        # Denominator gates (quadrature_gates): the complete quadrature
+        # picture of 1/(d_i + ·); also fed to the tower as well-scaled
+        # features (log1p(d) alone is a single number; the gates give
+        # the MLP the kernel the exact score actually uses).
+        self._gates = quadrature_gates(self._d, self._quad_t)
         # Positive-sample pool without touching M: a pair sharing any
         # contraction column (venue) has M[i,j] > 0, so sample a nonzero of
         # C then a co-occupant of its column. CSC-style column lists make
@@ -702,7 +722,19 @@ class NeuralPathSim:
         "struct" (default) uses the analytic Cauchy map — measured
         rerank recall@10 = 1.0 at 65k authors (NEURAL_r04.json);
         "learned" uses the compact trained tower for O(d) scans.
-        Returned scores are exact for the candidates considered."""
+        Returned scores are exact for the candidates considered.
+
+        The rerank routes through the SAME candidate-restricted exact
+        primitives the serving ANN path uses (ops/pathsim.
+        score_candidates + topk_from_candidate_scores), so both honor
+        the oracle tie order (descending score, ascending column) and
+        are bit-identical to the full exact top-k whenever the true
+        top-k is inside the candidate set. The previous bespoke sort
+        broke boundary ties by candidate-*position* (argpartition
+        order), which could disagree with the exact engine on tied
+        scores."""
+        from ..ops.pathsim import score_candidates, topk_from_candidate_scores
+
         if index == "struct":
             sims = self.struct_sims(source_index)
         elif index == "learned":
@@ -712,10 +744,21 @@ class NeuralPathSim:
             raise ValueError(f"unknown index {index!r}")
         sims[source_index] = -np.inf
         cand = np.argpartition(-sims, min(candidates, self.n - 1))[:candidates]
-        cand = cand[cand != source_index]
-        exact = self.pair_scores(np.full(len(cand), source_index), cand)
-        order = np.argsort(-exact, kind="stable")[:k]
-        return [(int(cand[t]), float(exact[t])) for t in order]
+        cand = cand[cand != source_index].astype(np.int64)
+        # exact integer counts for the candidate columns only — O(C·V),
+        # the same numbers the backend's full pairwise row carries
+        counts = self._c64[cand] @ self._c64[source_index]
+        scores = score_candidates(
+            counts[None, :],
+            np.asarray([self._d[source_index]]),
+            self._d[cand][None, :],
+        )
+        vals, idxs = topk_from_candidate_scores(scores, cand[None, :], k)
+        return [
+            (int(j), float(v))
+            for v, j in zip(vals[0], idxs[0])
+            if np.isfinite(v)
+        ]
 
     # Refuse to densify the exact score matrix beyond this many entries.
     _DENSE_SCORES_MAX_ENTRIES = 1 << 26

@@ -89,6 +89,15 @@ def _build_worker_service(args):
         warm=not args.no_warm,
         batch_events=args.batch_events,
         delta_threshold=args.delta_threshold,
+        topk_mode=args.topk_mode,
+        index_path=args.index,
+        ann_nprobe=args.ann_nprobe,
+        ann_cand_mult=args.ann_cand_mult,
+        ann_centroids=args.ann_centroids,
+        ann_cluster_cap=args.ann_cluster_cap,
+        ann_variant=args.ann_variant,
+        ann_shadow_every=args.ann_shadow_every,
+        ann_auto_refresh=not args.no_ann_refresh,
     )
     if args.dataset.startswith("synthetic:"):
         from ..backends.base import create_backend
@@ -184,9 +193,13 @@ _FORWARD_VALUE = (
     "dataset", "backend", "metapath", "variant", "loader", "platform",
     "dtype", "k", "max_batch", "max_wait_ms", "queue_depth",
     "cache_entries", "tile_cache_mb", "headroom", "delta_threshold",
-    "tuning_table",
+    "tuning_table", "topk_mode", "index", "ann_nprobe", "ann_cand_mult",
+    "ann_centroids", "ann_cluster_cap", "ann_variant",
+    "ann_shadow_every",
 )
-_FORWARD_TRUE = ("no_warm", "no_metrics", "no_tuning", "approx")
+_FORWARD_TRUE = (
+    "no_warm", "no_metrics", "no_tuning", "approx", "no_ann_refresh",
+)
 
 
 def build_router_parser() -> argparse.ArgumentParser:

@@ -998,6 +998,12 @@ class Router:
                             "epoch": w.epoch,
                             "lag": head - w.epoch,
                             "token": list(w.token) if w.token else None,
+                            # ANN index epoch from the last pong (None =
+                            # exact-only replica): operators see which
+                            # replicas hold a fresh candidate index;
+                            # queries never NEED one — an ann request on
+                            # an index-less replica answers exactly
+                            "index": w.last_health.get("index"),
                         }
                         for w in self.workers.values()
                     },

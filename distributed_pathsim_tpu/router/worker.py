@@ -175,6 +175,10 @@ class WorkerRuntime:
             fail(str(exc.args[0] if exc.args else exc))
             return
         k = int(req.get("k") or self.service.config.k_default)
+        mode = req.get("mode")
+        if mode not in (None, "exact", "ann"):
+            fail(f"unknown topk mode {mode!r}")
+            return
         t0 = time.perf_counter()
         # Transient dispatch faults retry LOCALLY first, under a policy
         # CLAMPED to the caller's remaining budget (deadline_ms →
@@ -190,9 +194,13 @@ class WorkerRuntime:
         if deadline is not None:
             policy = deadline.clamp(policy)
         try:
+            # mode rides through: a replica WITHOUT an index answers an
+            # "ann" request exactly (counted as a no_index fallback) —
+            # which is what makes re-dispatching an ann query onto any
+            # surviving replica always safe
             future = resilient_call(
                 "worker_dispatch",
-                lambda: self.service.submit_topk(row, k),
+                lambda: self.service.submit_topk(row, k, mode=mode),
                 policy,
             )
         except LoadShedError:

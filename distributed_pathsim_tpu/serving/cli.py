@@ -118,6 +118,58 @@ def build_serve_parser() -> argparse.ArgumentParser:
         "--no-tuning", action="store_true",
         help="ignore any tuning table (env included)",
     )
+    p.add_argument(
+        "--topk-mode", default="exact", choices=("exact", "ann"),
+        help="default topk answer path: 'exact' scores the full O(N) "
+        "row; 'ann' probes the MIPS candidate index and exact-reranks "
+        "C >> k candidates (per-request override via the protocol's "
+        "'mode' field; ineligible rows silently degrade to exact)",
+    )
+    p.add_argument(
+        "--index", default=None,
+        help="prebuilt `dpathsim index build` artifact (.npz); must "
+        "match the served graph's base fingerprint. Absent with "
+        "--topk-mode ann, the struct-embedded index is built "
+        "in-process at startup",
+    )
+    p.add_argument(
+        "--ann-nprobe", type=int, default=None,
+        help="clusters probed per ANN query (default: tuning registry)",
+    )
+    p.add_argument(
+        "--ann-cand-mult", type=int, default=None,
+        help="candidates per ANN query as a multiple of k (default: "
+        "tuning registry)",
+    )
+    p.add_argument(
+        "--ann-centroids", type=int, default=None,
+        help="centroid count for the in-process index build "
+        "(default: tuned multiplier on sqrt(N))",
+    )
+    p.add_argument(
+        "--ann-cluster-cap", type=int, default=None,
+        help="packed-cluster capacity for the in-process index build "
+        "(default: tuning registry / auto)",
+    )
+    p.add_argument(
+        "--ann-variant", default=None,
+        choices=("rerank-all", "shortlist"),
+        help="candidate-generation strategy (default: tuning "
+        "registry; 'rerank-all' exact-reranks every probed member, "
+        "'shortlist' cuts to cand_mult*k by embedding similarity "
+        "first)",
+    )
+    p.add_argument(
+        "--ann-shadow-every", type=int, default=64,
+        help="every Nth ANN dispatch also runs the exact oracle and "
+        "feeds the recall-confidence gate (0 disables shadowing)",
+    )
+    p.add_argument(
+        "--no-ann-refresh", action="store_true",
+        help="disable the background re-embed of delta-staled index "
+        "rows (they then stay on the exact path until the "
+        "'refresh_index' op)",
+    )
     return p
 
 
@@ -160,6 +212,15 @@ def serve_main(argv: list[str] | None = None) -> int:
         warm=not args.no_warm,
         batch_events=args.batch_events,
         delta_threshold=args.delta_threshold,
+        topk_mode=args.topk_mode,
+        index_path=args.index,
+        ann_nprobe=args.ann_nprobe,
+        ann_cand_mult=args.ann_cand_mult,
+        ann_centroids=args.ann_centroids,
+        ann_cluster_cap=args.ann_cluster_cap,
+        ann_variant=args.ann_variant,
+        ann_shadow_every=args.ann_shadow_every,
+        ann_auto_refresh=not args.no_ann_refresh,
     )
     from .. import obs
 

@@ -73,7 +73,12 @@ class Request:
     before the cache lookups under the swap lock) — the origin the
     submit-to-resolve latency histogram measures from, shared with the
     cache-hit outcomes so the per-outcome distributions are
-    origin-comparable; 0.0 when the caller didn't stamp one."""
+    origin-comparable; 0.0 when the caller didn't stamp one.
+
+    ``lane`` routes the batch to one of the service's dispatch paths:
+    a batch is single-lane (the batch former never mixes lanes), so
+    e.g. ANN candidate-generation probes coalesce into their own
+    batched matmul while exact queries keep theirs."""
 
     row: int
     k: int
@@ -82,6 +87,7 @@ class Request:
     span: Any = None
     enq_span: Any = None
     t_submit: float = 0.0
+    lane: str = "exact"
 
 
 @dataclasses.dataclass
@@ -96,11 +102,12 @@ class BatchStats:
 class Coalescer:
     """Batch former + double-buffered dispatch pipeline.
 
-    ``issue(rows_padded, k)`` runs on the dispatcher thread and returns
-    an opaque in-flight handle (device array, host array — anything);
-    ``complete(handle, rows, requests, k)`` runs on the completion
-    thread and must resolve every request's future. Exceptions from
-    either land on every future of the batch.
+    ``issue(rows_padded, k, lane)`` runs on the dispatcher thread and
+    returns an opaque in-flight handle (device array, host array —
+    anything); ``complete(handle, rows, requests, k, lane)`` runs on
+    the completion thread and must resolve every request's future.
+    ``lane`` is the batch's (single) lane — the service dispatches on
+    it. Exceptions from either land on every future of the batch.
     """
 
     def __init__(
@@ -196,7 +203,7 @@ class Coalescer:
     # -- admission ---------------------------------------------------------
 
     def submit(self, row: int, k: int, span=None,
-               t_submit: float = 0.0) -> Future:
+               t_submit: float = 0.0, lane: str = "exact") -> Future:
         """Admit one query; returns its Future. Raises
         :class:`LoadShedError` immediately when the queue is at bound —
         overload must fail fast, not queue unboundedly.
@@ -245,7 +252,7 @@ class Coalescer:
             self._queue.append(
                 Request(row=int(row), k=int(k), future=fut,
                         t_enqueue=time.monotonic(), span=span,
-                        enq_span=enq, t_submit=t_submit)
+                        enq_span=enq, t_submit=t_submit, lane=lane)
             )
             self._m_queue_depth.set(len(self._queue))
             self._not_empty.notify()
@@ -255,7 +262,13 @@ class Coalescer:
 
     def _take_batch(self) -> list[Request] | None:
         """Block for the first request, then coalesce stragglers up to
-        ``max_batch`` or ``max_wait``. Returns None on shutdown."""
+        ``max_batch`` or ``max_wait``. Returns None on shutdown.
+
+        Batches are single-lane: the head request's lane defines the
+        batch, and coalescing stops at the first queued request of a
+        different lane (FIFO order is preserved — the other lane heads
+        the next batch), so an exact batch and an ANN probe batch can
+        never be padded into one dispatch."""
         with self._lock:
             while not self._queue:
                 if self._closing:
@@ -268,9 +281,12 @@ class Coalescer:
             # under it and dispatch old-graph rows against the new one.
             batch = [self._queue.popleft()]
             self._inflight_n += 1
+            lane = batch[0].lane
             deadline = batch[0].t_enqueue + self.max_wait_s
             while len(batch) < self.max_batch:
                 if self._queue:
+                    if self._queue[0].lane != lane:
+                        break
                     batch.append(self._queue.popleft())
                     continue
                 remaining = deadline - time.monotonic()
@@ -309,6 +325,7 @@ class Coalescer:
                 tracer.start_span(
                     "serve.dispatch", parent=head.span.context,
                     n=len(batch), bucket=bucket, k=k,
+                    lane=batch[0].lane,
                 )
                 if head is not None
                 else None
@@ -334,7 +351,7 @@ class Coalescer:
                     else None
                 )
                 try:
-                    handle = self._issue(padded, k)
+                    handle = self._issue(padded, k, batch[0].lane)
                 finally:
                     tracer.finish(dev)
             except BaseException as exc:  # route, don't kill the thread
@@ -375,7 +392,8 @@ class Coalescer:
                 # sampled out (ctx None) must not start orphan traces.
                 with tracer.activate(dispatch_ctx):
                     with tracer.child_span("serve.complete", n=len(batch)):
-                        self._complete(handle, rows, batch, k)
+                        self._complete(handle, rows, batch, k,
+                                       batch[0].lane)
             except BaseException as exc:
                 for r in batch:
                     # same guard for span and future: members the

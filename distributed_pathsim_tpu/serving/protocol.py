@@ -21,6 +21,17 @@ Requests::
     {"id": 11, "op": "drain"}
     {"id": 12, "op": "topk", "row": 17, "request_id": "r42",
      "deadline_ms": 250.0}
+    {"id": 13, "op": "topk", "row": 17, "mode": "ann"}
+    {"id": 14, "op": "refresh_index"}
+
+``topk`` accepts an optional ``mode`` (``"exact"`` | ``"ann"``,
+default the service's ``--topk-mode``): ``ann`` answers through the
+MIPS candidate index + exact f64 rerank (DESIGN.md §23) and silently
+degrades to the exact path — counted per reason — whenever the index
+can't vouch for the row (delta-staled, appended after the build,
+recall confidence lost, or no index installed). ``refresh_index``
+re-embeds delta-staled index rows in place and advances the index
+epoch; it is the in-band twin of the automatic background refresh.
 
 Two optional fields extend EVERY request, defaulted so yesterday's
 clients keep working unchanged:
@@ -177,6 +188,7 @@ def _dispatch_op(
         hits = service.topk(
             k=req.get("k"),
             timeout_s=deadline.remaining_s() if deadline else None,
+            mode=req.get("mode"),
             **kwargs,
         )
         return {
@@ -184,6 +196,8 @@ def _dispatch_op(
                 {"id": i, "label": lab, "score": s} for i, lab, s in hits
             ]
         }
+    if op == "refresh_index":
+        return service.refresh_index()
     if op == "update":
         from ..data.delta import delta_from_records
 

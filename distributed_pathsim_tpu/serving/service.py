@@ -59,6 +59,33 @@ class ServeConfig:
     # graph's edges (or exhausting index headroom) rebuilds instead of
     # patching — past it the O(Δ) machinery converges on rebuild cost.
     delta_threshold: float = 0.05
+    # -- ANN candidate generation (index/ subsystem, DESIGN.md §23) ----
+    # Default answer path: "exact" scores the full O(N) row; "ann"
+    # probes the MIPS index for C ≫ k candidates and exact-reranks
+    # them (per-request override via the protocol's ``mode`` field).
+    topk_mode: str = "exact"
+    # Prebuilt `dpathsim index build` artifact; None + mode "ann"
+    # builds the struct-embedded index in-process at startup.
+    index_path: str | None = None
+    # Index geometry / probe knobs: None resolves through the tuning
+    # registry (ann_nprobe / ann_cand_mult / ann_centroids /
+    # ann_cluster_cap) with the documented heuristics as defaults.
+    ann_nprobe: int | None = None
+    ann_cand_mult: int | None = None
+    ann_centroids: int | None = None
+    ann_cluster_cap: int | None = None
+    ann_variant: str | None = None   # rerank-all | shortlist
+    # Shadow-recall confidence: every Nth ANN dispatch also runs the
+    # exact oracle and folds recall@k into dpathsim_ann_recall_ratio;
+    # below the floor (after min samples) ANN disables itself until a
+    # refresh/rebuild. 0 disables shadowing (benches own their oracle).
+    ann_shadow_every: int = 64
+    ann_recall_floor: float = 0.98
+    ann_min_shadow: int = 8
+    # Re-embed delta-staled rows in a background thread after each
+    # patch update (stale rows answer exactly in the meantime either
+    # way); off = refresh only via the refresh_index op/method.
+    ann_auto_refresh: bool = True
 
 
 class PathSimService:
@@ -100,7 +127,7 @@ class PathSimService:
                 "dpathsim_serve_request_seconds",
                 "submit-to-resolve request latency by outcome",
             ).labels(outcome=outcome)
-            for outcome in ("hit_result", "hit_tile", "dispatch")
+            for outcome in ("hit_result", "hit_tile", "dispatch", "ann")
         }
         self._m_updates = reg.counter(
             "dpathsim_serve_updates_total",
@@ -111,6 +138,13 @@ class PathSimService:
         from ..utils.xla_flags import install_compile_metrics
 
         install_compile_metrics()
+        if self.config.topk_mode not in ("exact", "ann"):
+            raise ValueError(
+                f"unknown topk_mode {self.config.topk_mode!r}; "
+                "choose 'exact' or 'ann'"
+            )
+        self._ann = None  # AnnState once _setup_ann builds/loads one
+        self._ann_refresh_inflight = False  # background-refresh debounce
         self._install_backend(backend, warm=self.config.warm)
         self.coalescer = Coalescer(
             issue=self._issue,
@@ -188,6 +222,119 @@ class PathSimService:
                 k=self.config.k_default,
                 variant=self.variant,
             )
+        self._setup_ann(warm=warm)
+
+    def _setup_ann(self, warm: bool) -> None:
+        """(Re)build or load the ANN candidate index for the freshly
+        installed backend (DESIGN.md §23). Every defect degrades to
+        exact serving with a loud event, never a crash — exact is the
+        ground truth, so losing the index only loses the speedup."""
+        cfg = self.config
+        if self._ann is not None:
+            # a reload/rebuild replaces the state: release the old
+            # rerank pool (and drop its C/blocks snapshots) instead of
+            # leaking one executor per swap
+            self._ann.close()
+        self._ann = None
+        if cfg.topk_mode != "ann" and cfg.index_path is None:
+            return
+        from .. import tuning
+        from ..index import CentroidIndex, IndexMismatch, build_index
+        from ..index.build import half_chain_and_denominators
+        from .ann import AnnState
+
+        t0 = time.perf_counter()
+        try:
+            c, d = half_chain_and_denominators(
+                self.hin, self.metapath, self.variant
+            )
+        except (ValueError, MemoryError) as exc:
+            runtime_event("ann_unavailable", reason=str(exc))
+            return
+        if cfg.index_path is not None:
+            try:
+                index = CentroidIndex.load(
+                    cfg.index_path, expect_base_fp=self._base_fp
+                )
+            except (IndexMismatch, OSError, KeyError, ValueError) as exc:
+                runtime_event(
+                    "ann_index_rejected", path=cfg.index_path,
+                    reason=str(exc),
+                )
+                return
+            if tuple(index.token) != self.consistency_token:
+                # an artifact persisted mid-delta-stream: its rows may
+                # lag this replica's graph — refuse rather than serve
+                # candidates from an unverifiable epoch
+                runtime_event(
+                    "ann_index_rejected", path=cfg.index_path,
+                    reason=f"index token {index.token} != service "
+                    f"token {self.consistency_token}",
+                )
+                return
+            # the fingerprint pins the GRAPH; the embedding geometry
+            # must also match the served score function — candidates
+            # from a different variant/metapath would silently degrade
+            # recall while the exact rerank hides the mismatch
+            for axis, want in (("variant", self.variant),
+                               ("metapath", self.metapath.name)):
+                got = index.meta.get(axis)
+                if got is not None and got != want:
+                    runtime_event(
+                        "ann_index_rejected", path=cfg.index_path,
+                        reason=f"index {axis} {got!r} != served "
+                        f"{want!r}",
+                    )
+                    return
+        else:
+            index = build_index(
+                c=c, d=d, variant=self.variant, metapath=self.metapath,
+                n_centroids=cfg.ann_centroids,
+                cluster_cap=cfg.ann_cluster_cap,
+                token=self.consistency_token,
+            )
+        # scale-aware nprobe heuristic: K/3 clamped to [16, 96]
+        # (measured score-recall ≥ 0.99 with margin at the default
+        # geometry from 768 through 65k authors). At small N that
+        # scans much of the corpus — where ann doesn't matter anyway;
+        # at large N it is the sublinear regime. The default is the
+        # RECALL-SAFE point; a measured table trades it down per box
+        # (the tuner's recall floor keeps any tuned arm honest)
+        nprobe = cfg.ann_nprobe or tuning.choose(
+            "ann_nprobe", n=self.n,
+            default=min(max(16, index.n_centroids // 3), 96),
+        )
+        cand_mult = cfg.ann_cand_mult or tuning.choose(
+            "ann_cand_mult", n=self.n, default=16
+        )
+        variant = cfg.ann_variant or tuning.choose(
+            "ann_probe_variant", n=self.n, default="rerank-all"
+        )
+        self._ann = AnnState(
+            index, c, d,
+            nprobe=int(nprobe), cand_mult=int(cand_mult),
+            variant=str(variant),
+            shadow_every=cfg.ann_shadow_every,
+            recall_floor=cfg.ann_recall_floor,
+            min_shadow=cfg.ann_min_shadow,
+        )
+        if warm and not (
+            self._ann.variant == "rerank-all" and self._ann.route_on_host
+        ):
+            # the ANN analog of the bucket warmup: one compiled probe
+            # per serving bucket, so steady state compiles nothing
+            # (host routing compiles nothing to begin with)
+            index.warm(self._bucket_ladder, self._ann.nprobe,
+                       variant=self._ann.variant)
+        runtime_event(
+            "ann_ready",
+            n=index.n, centroids=index.n_centroids,
+            cluster_cap=index.cluster_cap, dim=index.dim,
+            nprobe=self._ann.nprobe, cand_mult=self._ann.cand_mult,
+            variant=self._ann.variant,
+            source="file" if cfg.index_path else "built",
+            startup_s=round(time.perf_counter() - t0, 3),
+        )
 
     def _epoch_for(self, row: int) -> tuple:
         """Cache-identity prefix for one source row: install-time base
@@ -203,11 +350,26 @@ class PathSimService:
 
     # -- dispatch plumbing (runs on coalescer threads) ---------------------
 
-    def _issue(self, rows_padded: np.ndarray, k: int):
+    def _issue(self, rows_padded: np.ndarray, k: int, lane: str = "exact"):
         """Dispatcher-thread half of a batch: returns the in-flight
         counts handle. jax backends return an un-fetched device array
         (async dispatch → the double buffer overlaps transfer with the
-        next bucket's GEMM); others return host counts directly."""
+        next bucket's GEMM); others return host counts directly. The
+        ``ann`` lane issues the index probe instead — one batched
+        matmul over the packed cluster blocks, same async-handle
+        contract."""
+        if lane == "ann":
+            if self._ann.variant == "rerank-all":
+                if self._ann.route_on_host:
+                    return self._ann.index.route_batch_host(
+                        rows_padded, self._ann.nprobe
+                    )
+                return self._ann.index.route_batch_device(
+                    rows_padded, self._ann.nprobe
+                )
+            return self._ann.index.probe_batch_device(
+                rows_padded, self._ann.nprobe
+            )
         issue_device = getattr(self.backend, "pairwise_rows_device", None)
         if issue_device is not None:
             handle = issue_device(rows_padded)
@@ -215,18 +377,86 @@ class PathSimService:
                 return handle
         return self.backend.pairwise_rows(rows_padded)
 
+    def _complete_ann(
+        self, handle, rows: np.ndarray, batch: Sequence[Request]
+    ) -> None:
+        """Completion half of an ``ann`` batch: fetch the probed
+        similarities, select each request's C = cand_mult·k candidates
+        on host, exact-f64-rerank them against the C/d snapshot, fill
+        the ann result-cache tier, resolve futures. Every Nth dispatch
+        also runs the exact oracle for its row (shadow sampling) to
+        keep the recall-confidence gate honest."""
+        tracer = get_tracer()
+        ann = self._ann
+        t0 = time.perf_counter()
+        with tracer.child_span(
+            "serve.ann_probe_transfer", n=int(rows.shape[0])
+        ):
+            first = np.asarray(handle[0])
+            second = np.asarray(handle[1])
+        ann.observe_probe(time.perf_counter() - t0)
+
+        def _rerank_one(b: int):
+            row = int(rows[b])
+            k_eff = min(batch[b].k, max(self.n - 1, 1))
+            t1 = time.perf_counter()
+            if ann.variant == "rerank-all":
+                # (mem, top_c): exact-rerank every probed member
+                vals, idxs = ann.rerank_all(
+                    row, first[b], second[b], k_eff, self.n
+                )
+            else:
+                # (sims, mem): approximate shortlist → exact rerank
+                cand = ann.candidates_for(
+                    first[b], second[b], k_eff, self.n
+                )
+                vals, idxs = ann.rerank(row, cand, k_eff)
+            ann.observe_rerank(time.perf_counter() - t1)
+            return k_eff, vals, idxs
+
+        with tracer.child_span("serve.ann_rerank", n=len(batch)):
+            # per-request reranks are independent: fan them over the
+            # ann pool (numpy/BLAS release the GIL), resolve in order
+            reranked = list(ann.pool.map(_rerank_one, range(len(batch))))
+            shadows = []
+            for b, req in enumerate(batch):
+                row = int(rows[b])
+                k_eff, vals, idxs = reranked[b]
+                ann.count_answered()
+                if ann.should_shadow():
+                    # deferred: the O(N) oracle scan must never sit in
+                    # front of a waiting future — the sampled request's
+                    # (and the rest of the batch's) latency is exactly
+                    # what the ANN path exists to shrink
+                    shadows.append((row, k_eff, vals))
+                self.result_cache.put(self._ann_key(row, req.k), vals, idxs)
+                if not req.future.done():
+                    req.future.set_result((vals, idxs))
+                self._m_latency["ann"].observe(
+                    time.monotonic() - (req.t_submit or req.t_enqueue)
+                )
+                tracer.finish(req.span, outcome="ann")
+            for row, k_eff, vals in shadows:  # every future resolved
+                evals, _ = self.backend.topk_row(
+                    row, k=k_eff, variant=self.variant
+                )
+                ann.record_shadow(vals, evals, k_eff)
+
     def _complete(
         self,
         handle,
         rows: np.ndarray,
         batch: Sequence[Request],
         k: int,
+        lane: str = "exact",
     ) -> None:
         """Completion-thread half: fetch counts, normalize in f64, top-k
         per request (each gets the k-prefix it asked for), fill both
         cache tiers, resolve futures. The tracer spans opened here
         parent into the batch's ``serve.complete`` span — the coalescer
         activated its context on this thread before calling."""
+        if lane == "ann":
+            return self._complete_ann(handle, rows, batch)
         tracer = get_tracer()
         with tracer.child_span("serve.host_transfer", n=int(rows.shape[0])):
             # column trim to the logical width: device handles from a
@@ -295,33 +525,86 @@ class PathSimService:
             self.node_type, label=source, node_id=source_id
         )
 
-    def submit_topk(self, row: int, k: int | None = None) -> Future:
+    def _resolve_mode(self, mode: str | None) -> str:
+        """Per-request mode override → effective answer path."""
+        if mode is None:
+            mode = self.config.topk_mode
+        if mode not in ("exact", "ann"):
+            raise ValueError(
+                f"unknown topk mode {mode!r}; choose 'exact' or 'ann'"
+            )
+        return mode
+
+    def _ann_key(self, row: int, k: int) -> tuple:
+        """ANN result-cache key: the exact path's epoch prefix (base
+        fp + per-row delta version — a delta on this row invalidates
+        both tiers' entries the same way) plus an ``ann`` axis so an
+        approximate answer can never be served to an exact query or
+        vice versa."""
+        return (*self._epoch_for(row), "ann", self._ann.variant,
+                self._ann.nprobe, self._ann.cand_mult, int(row), int(k))
+
+    def submit_topk(self, row: int, k: int | None = None,
+                    mode: str | None = None) -> Future:
         """Admit a top-k query; returns a Future of (values, indices).
         Cache hits resolve immediately; misses ride the coalescer.
         Raises :class:`coalescer.LoadShedError` at the queue bound.
+
+        ``mode`` (None → the service default ``config.topk_mode``)
+        picks the answer path: ``exact`` scores the full row; ``ann``
+        probes the candidate index and exact-reranks — and silently
+        degrades to exact (counted, per reason) whenever the index
+        can't vouch for this row (stale/unseen/degenerate/confidence
+        lost/no index). Exact is ground truth, so degrading is always
+        safe; it only costs the speedup.
 
         Every admission opens a root ``serve.request`` span: cache hits
         finish it here; coalesced misses carry it across the
         dispatcher/completer thread hop, so one request = one connected
         trace (enqueue → dispatch → device → transfer → cache fill)."""
         k = int(k or self.config.k_default)
+        mode = self._resolve_mode(mode)
         tracer = get_tracer()
-        root = tracer.start_span("serve.request", row=int(row), k=k)
+        root = tracer.start_span(
+            "serve.request", row=int(row), k=k, mode=mode
+        )
         t0 = time.monotonic()
         try:
             with self._swap_lock:
-                return self._submit_topk_locked(int(row), k, root, t0)
+                return self._submit_topk_locked(int(row), k, root, t0, mode)
         except BaseException as exc:
             tracer.finish(root, outcome=type(exc).__name__)
             raise
 
     def _submit_topk_locked(self, row: int, k: int, root=None,
-                            t0: float = 0.0) -> Future:
+                            t0: float = 0.0, mode: str = "exact") -> Future:
         # Under _swap_lock: a reload drains the pipeline then swaps the
         # backend — admissions must not interleave with that swap (the
         # drain would never finish, and a request could resolve rows
         # against one graph and dispatch against another).
         tracer = get_tracer()
+        if mode == "ann":
+            if self._ann is None:
+                get_registry().counter(
+                    "dpathsim_ann_fallbacks_total",
+                    "ann-requested queries answered exactly instead, "
+                    "by reason",
+                ).inc(reason="no_index")
+            elif self._ann.eligible(row) is None:
+                key = self._ann_key(row, k)
+                hit = self.result_cache.get(key)
+                if hit is not None:
+                    fut: Future = Future()
+                    fut.set_result(hit)
+                    self._m_latency["hit_result"].observe(
+                        time.monotonic() - t0
+                    )
+                    tracer.finish(root, outcome="hit_result")
+                    return fut
+                return self.coalescer.submit(
+                    int(row), k, span=root, t_submit=t0, lane="ann"
+                )
+            # ineligible (already counted by reason): exact fallback
         epoch = self._epoch_for(row)
         key = (*epoch, int(row), k)
         hit = self.result_cache.get(key)
@@ -348,7 +631,8 @@ class PathSimService:
         return self.coalescer.submit(int(row), k, span=root, t_submit=t0)
 
     def topk_index(self, row: int, k: int | None = None,
-                   timeout_s: float | None = None):
+                   timeout_s: float | None = None,
+                   mode: str | None = None):
         """Synchronous top-k by dense row index → (values, indices).
         ``timeout_s`` caps the wait below the service-wide default —
         the protocol's ``deadline_ms`` budget lands here, so a request
@@ -356,7 +640,7 @@ class PathSimService:
         timeout = self.config.request_timeout_s
         if timeout_s is not None:
             timeout = min(timeout, max(timeout_s, 0.0))
-        return self.submit_topk(row, k).result(timeout=timeout)
+        return self.submit_topk(row, k, mode=mode).result(timeout=timeout)
 
     def _ident(self, i: int) -> tuple[str, str]:
         """(id, label) for a dense index — huge synthetic graphs carry
@@ -369,11 +653,11 @@ class PathSimService:
 
     def topk(self, source: str | None = None, source_id: str | None = None,
              row: int | None = None, k: int | None = None,
-             timeout_s: float | None = None):
+             timeout_s: float | None = None, mode: str | None = None):
         """Synchronous top-k by label / id / row, resolved to ids:
         list of (target_id, target_label, score)."""
         r = self.resolve(source=source, source_id=source_id, row=row)
-        vals, idxs = self.topk_index(r, k, timeout_s=timeout_s)
+        vals, idxs = self.topk_index(r, k, timeout_s=timeout_s, mode=mode)
         return [
             (*self._ident(int(i)), float(v))
             for v, i in zip(vals, idxs)
@@ -435,6 +719,19 @@ class PathSimService:
             "delta_seq": self._delta_seq,
             "fingerprint": self._fp,
             "backend": self.backend.name,
+            # index epoch: lets a router (or operator) see which
+            # replicas hold a fresh ANN index — a replica without one
+            # still answers every query, exactly (None = exact-only)
+            "index": (
+                {
+                    "mode": self.config.topk_mode,
+                    "epoch": list(self._ann.index.token),
+                    "stale_rows": self._ann.index.stale_count,
+                    "enabled": self._ann.enabled,
+                }
+                if self._ann is not None
+                else None
+            ),
             # process-lifetime XLA compile count: a steady-state worker
             # whose number moves is violating the shape-bucket contract
             # (the router smoke's zero-recompile gate reads this)
@@ -504,6 +801,12 @@ class PathSimService:
                 self._delta_seq += 1
                 self._fp = plan.fingerprint
                 affected_n = int(affected.shape[0])
+                if self._ann is not None:
+                    # the index's rows for this delta are now a graph
+                    # behind: fence them onto the exact path until the
+                    # (background) refresh re-embeds them. Appended
+                    # rows are uncovered by construction.
+                    self._ann.index.mark_stale(affected)
                 if want_rows:
                     # the router's fencing machinery needs the SET, not
                     # the count: a replica that missed this delta is
@@ -546,6 +849,131 @@ class PathSimService:
                 # None under rebuild: "all rows" — the fence must cover
                 # everything, not an empty set
                 result["affected_row_list"] = affected_list
+            if self._ann is not None:
+                result["ann_stale_rows"] = self._ann.index.stale_count
+                if (
+                    mode == "delta"
+                    and self.config.ann_auto_refresh
+                    and self._ann.index.stale_count
+                    # learned indexes can't re-embed in place; they
+                    # stay on the exact path for stale rows until an
+                    # offline rebuild (refresh_index reports the same)
+                    and self._ann.index.meta.get("embedding") == "struct"
+                    # debounced: one refresh in flight at a time — a
+                    # sustained delta stream must not spawn a thread
+                    # (and pay a half-chain fold) per delta only to
+                    # abandon at the token check; the in-flight
+                    # refresh snapshots the token AFTER taking the
+                    # lock, so it folds the newest graph state anyway
+                    and not self._ann_refresh_inflight
+                ):
+                    # background re-embed: stale rows answer exactly in
+                    # the meantime, so serving correctness never waits
+                    # on this thread (it blocks on the swap lock we
+                    # still hold, then runs)
+                    self._ann_refresh_inflight = True
+                    threading.Thread(
+                        target=self._refresh_index_quietly,
+                        name="pathsim-ann-refresh", daemon=True,
+                    ).start()
+            return result
+
+    def _refresh_index_quietly(self) -> None:
+        try:
+            # an abandoned attempt (a newer delta landed mid-fold)
+            # retries against the newer token — deltas that arrived
+            # while we were the debounced in-flight refresh must not
+            # be left stale until some future update happens by
+            while self.refresh_index().get("abandoned"):
+                pass
+        except Exception as exc:  # background thread: report, never die
+            runtime_event("ann_refresh_failed", error=repr(exc))
+        finally:
+            self._ann_refresh_inflight = False
+
+    def refresh_index(self) -> dict:
+        """Re-embed every delta-staled index row in place and advance
+        the index's consistency token to the service's — the
+        "background refresh" half of the staleness contract (DESIGN.md
+        §23). Embeddings come from the PATCHED graph on the index's
+        pinned quadrature grid/projection, so refreshed rows stay
+        inner-product-consistent with the rest of the index. Rows the
+        index cannot hold (appended past the build) stay on the exact
+        path; the accounting reports them. Also re-snapshots C/d for
+        the exact rerank and resets the shadow-recall gate (old
+        evidence described the old index state).
+
+        The expensive inputs (half-chain fold, re-embedding) are
+        computed OUTSIDE the swap lock against a token snapshot —
+        serving keeps flowing while they build — and applied under the
+        lock only if no further delta landed meanwhile (a newer delta
+        has already scheduled its own refresh, so abandoning is
+        correct, not lossy)."""
+        from ..index.build import (
+            half_chain_and_denominators, refresh_embeddings,
+        )
+
+        t0 = time.perf_counter()
+        with self._swap_lock:
+            ann = self._ann
+            if ann is None:
+                return {"ann": False, "refreshed": 0}
+            if ann.index.meta.get("embedding") != "struct":
+                # learned indexes re-embed by re-running the tower
+                # offline — surface "rebuild required" instead of
+                # paying the fold just to hit build.py's ValueError
+                result = {
+                    "ann": True, "refreshed": 0,
+                    "stale_remaining": ann.index.stale_count,
+                    "unsupported": "learned-embedding index: rebuild "
+                    "offline (dpathsim index build) and reload",
+                }
+                runtime_event("ann_refresh_unavailable", **result)
+                return result
+            token0 = self.consistency_token
+            hin = self.hin
+            stale_rows = np.flatnonzero(ann.index.stale)
+        c, d = half_chain_and_denominators(
+            hin, self.metapath, self.variant
+        )
+        emb = (
+            refresh_embeddings(ann.index, stale_rows, c, d)
+            if stale_rows.size else None
+        )
+        with self._swap_lock:
+            if self._ann is not ann or self.consistency_token != token0:
+                runtime_event(
+                    "ann_refresh_abandoned", token=list(token0),
+                    reason="newer delta landed during the re-embed",
+                )
+                return {"ann": True, "refreshed": 0, "abandoned": True}
+            # drained like update(): the probe reads the index arrays
+            # this refresh mutates, and a batch must never straddle it
+            self.coalescer.drain()
+            unplaced: list[int] = []
+            if emb is not None:
+                unplaced = ann.index.refresh_rows(
+                    stale_rows, emb, token=token0
+                )
+            else:
+                ann.index.token = token0
+            c.flags.writeable = False
+            ann.c64 = c
+            ann.d = d
+            if ann.variant == "rerank-all":
+                ann.rebind_counts()  # blocks must mirror the new slots
+            ann.reset_confidence()
+            ms = round((time.perf_counter() - t0) * 1e3, 3)
+            result = {
+                "ann": True,
+                "refreshed": int(stale_rows.size) - len(unplaced),
+                "unplaced": len(unplaced),
+                "stale_remaining": ann.index.stale_count,
+                "uncovered_rows": max(self.n - ann.index.n, 0),
+                "token": list(ann.index.token),
+                "ms": ms,
+            }
+            runtime_event("ann_refresh", **result)
             return result
 
     def reload(self, backend: PathSimBackend) -> None:
@@ -598,6 +1026,8 @@ class PathSimService:
             "variant": self.variant,
             "backend": self.backend.name,
             "fingerprint": self._fp,
+            "topk_mode": self.config.topk_mode,
+            "ann": self._ann.snapshot() if self._ann is not None else None,
             "delta": {
                 "seq": self._delta_seq,
                 "base_fingerprint": self._base_fp,
@@ -628,6 +1058,8 @@ class PathSimService:
 
     def close(self) -> None:
         self.coalescer.close()
+        if self._ann is not None:
+            self._ann.close()
 
 
 def build_service(

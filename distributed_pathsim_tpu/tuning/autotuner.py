@@ -400,6 +400,209 @@ def bench_serve_buckets(n_authors: int, max_batch: int, reps: int,
     return {"serve_buckets": (winner, res)}
 
 
+def bench_ann(point: SweepPoint, reps: int, k: int = 10,
+              recall_floor: float = 0.99) -> dict:
+    """ANN index knobs (index/ subsystem), measured with a RECALL
+    GATE: an arm that misses the recall floor is excluded from the
+    race outright, not merely slower — a tuned index that forgot how
+    to find the true top-k is wrong, not fast. Probe + exact-rerank
+    wall time per query batch is the metric; measured recall rides
+    along in every arm record so table entries stay auditable.
+
+    Geometry knobs (``ann_centroids``, ``ann_cluster_cap``) each
+    build a real index per arm; probe knobs (``ann_nprobe``,
+    ``ann_cand_mult``) share one default-geometry index. All arms are
+    real on any platform — the probe is an XLA matmul, CPU or TPU."""
+    from ..data.synthetic import synthetic_hin
+    from ..index.build import (
+        build_index, default_centroids, half_chain_and_denominators,
+    )
+    from ..ops import pathsim
+    from ..ops.metapath import compile_metapath
+
+    n = point.n
+    hin = synthetic_hin(n, 2 * n, 24, seed=0)
+    mp = compile_metapath("APVPA", hin.schema)
+    c, d = half_chain_and_denominators(hin, mp)
+    rng = np.random.default_rng(0)
+    # sample only ANN-eligible rows: the serving layer answers
+    # degenerate rows (d <= 0, all-zero score ties) through the exact
+    # path unconditionally, so scoring them against the index would
+    # tax every arm with misses no arm can (or needs to) fix
+    eligible = np.flatnonzero(d > 0)
+    if eligible.size < 2:
+        return {}
+    sample = np.sort(rng.choice(
+        eligible, size=min(64, eligible.size), replace=False
+    ))
+    oracle_kth: dict[int, float] = {}
+    for row in sample:
+        scores = pathsim.score_row(c @ c[row], d[row], d)
+        scores[int(row)] = -np.inf
+        vals, _ = pathsim.topk_from_score_rows(scores[None, :], k)
+        oracle_kth[int(row)] = float(vals[0][-1])
+    qrows = rng.choice(eligible, size=(8, 32))
+    # the cache value keeps the INDEX alive too: keyed by id() alone,
+    # a garbage-collected index from an earlier race could recycle its
+    # address and hand the next race another geometry's blocks
+    blocks_cache: dict[int, tuple] = {}
+
+    def blocks_of(index) -> np.ndarray:
+        hit = blocks_cache.get(id(index))
+        if hit is not None and hit[0] is index:
+            return hit[1]
+        safe = np.maximum(index.members, 0)
+        bl = c[safe.reshape(-1)].reshape(
+            *index.members.shape, c.shape[1]
+        )
+        bl[index.members < 0] = 0.0
+        blocks_cache[id(index)] = (index, bl)
+        return bl
+
+    def answer(index, batch, nprobe: int, cand_mult: int, variant: str):
+        """Serve one probe batch the way the serving layer would, per
+        variant; yields (row, vals)."""
+        if variant == "rerank-all":
+            mem, top_c = index.route_batch(batch, nprobe)
+            blocks = blocks_of(index)
+            for b, row in enumerate(batch):
+                blk = blocks[top_c[b]]
+                counts = blk.reshape(-1, blk.shape[-1]) @ c[row]
+                cols = mem[b].astype(np.int64)
+                dc = d[np.maximum(cols, 0)]
+                sc = pathsim.score_candidates(
+                    counts[None, :], np.asarray([d[row]]), dc[None, :]
+                )
+                vals, _ = pathsim.topk_from_candidate_scores(
+                    sc, cols[None, :], k
+                )
+                yield int(row), vals[0]
+        else:
+            sims, mem = index.probe_batch(batch, nprobe)
+            for b, row in enumerate(batch):
+                cand = index.select_candidates(
+                    sims[b], mem[b], cand_mult * k
+                )
+                counts = c[cand] @ c[row]
+                sc = pathsim.score_candidates(
+                    counts[None, :], np.asarray([d[row]]),
+                    d[cand][None, :],
+                )
+                vals, _ = pathsim.topk_from_candidate_scores(
+                    sc, cand[None, :], k
+                )
+                yield int(row), vals[0]
+
+    def recall_of(index, nprobe: int, cand_mult: int,
+                  variant: str) -> float:
+        """Score recall@k (ties at the k boundary count — the serving
+        shadow gate's metric, serving/ann.py)."""
+        hits = tot = 0
+        for row, vals in answer(index, sample.astype(np.int64),
+                                nprobe, cand_mult, variant):
+            kth = oracle_kth[row]
+            got = vals[np.isfinite(vals)]
+            hits += min(int((got >= kth).sum()), k)
+            tot += k
+        return hits / max(tot, 1)
+
+    def timing_arm(index, nprobe: int, cand_mult: int, variant: str):
+        def run():
+            for batch in qrows:
+                for _ in answer(index, batch, nprobe, cand_mult,
+                                variant):
+                    pass
+
+        return run
+
+    def race(names) -> tuple | None:
+        """Measure the feasible arms of one knob; None when no arm
+        meets the recall floor (the knob keeps its heuristic)."""
+        arms, recalls = {}, {}
+        for name, (index, nprobe, mult, variant) in names.items():
+            r = recall_of(index, nprobe, mult, variant)
+            recalls[name] = r
+            if r >= recall_floor:
+                arms[name] = timing_arm(index, nprobe, mult, variant)
+        if not arms:
+            return None
+        res = br.time_interleaved(arms, reps)
+        for name in res:
+            res[name]["recall"] = round(recalls[name], 4)
+        return br.best_arm(res), res
+
+    out: dict = {}
+    idx0 = build_index(
+        c=c, d=d, metapath=mp,
+        n_centroids=default_centroids(n, 1.0),
+    )
+    nprobe_w = min(max(16, idx0.n_centroids // 3), 96)
+    mult_w = 16
+    var_w = "rerank-all"
+
+    raced = race({
+        f"var-{v_}": (idx0, nprobe_w, mult_w, v_)
+        for v_ in KNOBS["ann_probe_variant"].candidates({"n": n})
+    })
+    if raced is not None:
+        win, res = raced
+        var_w = win.removeprefix("var-")
+        out["ann_probe_variant"] = (var_w, res)
+
+    raced = race({
+        f"nprobe{p}": (idx0, p, mult_w, var_w)
+        for p in KNOBS["ann_nprobe"].candidates({"n": n})
+        if p <= idx0.n_centroids
+    })
+    if raced is not None:
+        win, res = raced
+        nprobe_w = int(win.removeprefix("nprobe"))
+        out["ann_nprobe"] = (nprobe_w, res)
+
+    raced = race({
+        f"mult{m}": (idx0, nprobe_w, m, "shortlist")
+        for m in KNOBS["ann_cand_mult"].candidates({"n": n})
+    })
+    if raced is not None:
+        win, res = raced
+        mult_w = int(win.removeprefix("mult"))
+        out["ann_cand_mult"] = (mult_w, res)
+
+    raced = race({
+        f"cmult{cm}": (
+            build_index(
+                c=c, d=d, metapath=mp,
+                n_centroids=default_centroids(n, float(cm)),
+            ),
+            nprobe_w, mult_w, var_w,
+        )
+        for cm in KNOBS["ann_centroids"].candidates({"n": n})
+    })
+    if raced is not None:
+        win, res = raced
+        out["ann_centroids"] = (float(win.removeprefix("cmult")), res)
+
+    raced = race({
+        f"cap{cap}": (
+            build_index(
+                c=c, d=d, metapath=mp,
+                n_centroids=default_centroids(n, 1.0),
+                cluster_cap=cap,
+            ),
+            nprobe_w, mult_w, var_w,
+        )
+        for cap in KNOBS["ann_cluster_cap"].candidates({"n": n})
+    })
+    if raced is not None:
+        win, res = raced
+        out["ann_cluster_cap"] = (int(win.removeprefix("cap")), res)
+    return out
+
+
+_ANN_KNOBS = ("ann_nprobe", "ann_cand_mult", "ann_centroids",
+              "ann_cluster_cap", "ann_probe_variant")
+
+
 # ---------------------------------------------------------------------------
 # Sweep driver
 # ---------------------------------------------------------------------------
@@ -443,6 +646,10 @@ def tune(
                     # band by warm cost — persist the deciding number
                     # so the entry stays auditable from the table alone
                     arms_out[f"{name}_warm"] = a["warm_ms"]
+                if "recall" in a:
+                    # ann knobs gate on measured recall before racing
+                    # on time — persist it per arm for the same reason
+                    arms_out[f"{name}_recall"] = a["recall"]
             table.put(
                 key, choice,
                 metric_ms=min(
@@ -465,6 +672,8 @@ def tune(
                 record(point, bench_k_tile(point, reps))
             if "ring_kernel" in want:
                 record(point, bench_ring(point, reps))
+            if want & set(_ANN_KNOBS):
+                record(point, bench_ann(point, reps))
         else:
             if "sparse_tile_rows" in want:
                 record(point, bench_sparse_tiles(point, reps),

@@ -109,6 +109,52 @@ KNOBS: dict[str, Knob] = {
             candidates=lambda ctx: ["rect-pallas", "jnp-fold"],
         ),
         Knob(
+            name="ann_centroids",
+            doc="MIPS index centroid count as a multiplier on √N "
+            "(index/build.default_centroids): more centroids → "
+            "smaller clusters → cheaper probes but weaker cluster "
+            "locality (recall needs more nprobe).",
+            candidates=lambda ctx: [0.5, 1.0, 2.0],
+        ),
+        Knob(
+            name="ann_cluster_cap",
+            doc="packed-cluster capacity (pad-to) of the MIPS index "
+            "blocks: probe cost is nprobe·cap·dim, so a tight cap is "
+            "cheaper per probe but spills more members off their "
+            "nearest centroid (recall). Feasibility (K·cap ≥ N) is "
+            "re-checked at build; an infeasible tuned cap is raised "
+            "loudly, never trusted.",
+            candidates=lambda ctx: [64, 128, 256, 512],
+        ),
+        Knob(
+            name="ann_probe_variant",
+            doc="ANN candidate generation strategy: 'rerank-all' "
+            "routes only (centroid top-nprobe + member ids) and "
+            "exact-reranks every probed member against packed "
+            "per-cluster count blocks (wins when the half-chain "
+            "width V is narrow — the rerank matmul is cheaper than "
+            "embedding-space scoring); 'shortlist' scores the probed "
+            "embedding blocks in one batched matmul and exact-reranks "
+            "only the top cand_mult·k (wins at wide V / on matmul "
+            "hardware). Both are exact-reranked, both bit-identical "
+            "when the true top-k is covered.",
+            candidates=lambda ctx: ["rerank-all", "shortlist"],
+        ),
+        Knob(
+            name="ann_nprobe",
+            doc="clusters probed per ANN query: the recall/latency "
+            "dial of candidate generation. Arms failing the recall "
+            "floor are excluded by the tuner, not merely slow.",
+            candidates=lambda ctx: [8, 16, 32, 48, 64, 96],
+        ),
+        Knob(
+            name="ann_cand_mult",
+            doc="candidate multiplier: C = mult·k candidates survive "
+            "the probe into the exact f64 rerank. Larger mult buys "
+            "recall at O(C·V) rerank cost per query.",
+            candidates=lambda ctx: [4, 8, 16, 32],
+        ),
+        Knob(
             name="serve_buckets",
             doc="serving bucket-ladder geometry pre-compiled at "
             "warmup: 'pow2' (1,2,4,…; <2x pad waste, log2(B)+1 "

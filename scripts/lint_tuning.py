@@ -9,8 +9,9 @@ may live; this lint rejects NEW hardcoded ones elsewhere.
 
 Rule: any module-level or class-level assignment of an integer (or
 all-integer tuple) constant whose name contains a tile/bucket token —
-``TILE``, ``BUCKET``, ``LADDER``, ``STRIPE``, or a bare ``BM``/``BN``/
-``BK`` name component — must either live in ``tuning/registry.py`` or
+``TILE``, ``BUCKET``, ``LADDER``, ``STRIPE``, a bare ``BM``/``BN``/
+``BK`` name component, or an index-geometry token (``CAP``,
+``CENTROID``, ``NPROBE``) — must either live in ``tuning/registry.py`` or
 be listed in ``registry.SANCTIONED_CONSTANTS`` with its justification
 (kernel-internal layout invariants and the documented heuristic floors
 of registered knobs). Everything else is a knob trying to escape the
@@ -35,7 +36,13 @@ PACKAGE = REPO / "distributed_pathsim_tpu"
 # Files that ARE the tuning subsystem: constants there are the registry.
 _EXEMPT = ("tuning/",)
 
-_TOKENS = {"TILE", "BUCKET", "LADDER", "STRIPE", "BM", "BN", "BK"}
+_TOKENS = {
+    "TILE", "BUCKET", "LADDER", "STRIPE", "BM", "BN", "BK",
+    # index-geometry knobs (ann_cluster_cap / ann_centroids /
+    # ann_nprobe): a hardcoded cap or centroid count in index/serving
+    # code is the same fossilization the tile tokens guard against
+    "CAP", "CENTROID", "NPROBE",
+}
 _SPLIT = re.compile(r"[^A-Za-z0-9]+")
 
 

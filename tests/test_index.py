@@ -1,0 +1,409 @@
+"""Sublinear top-k: MIPS index + exact rerank (DESIGN.md §23).
+
+The load-bearing guarantees:
+
+- the candidate-restricted scoring primitives are bit-identical to the
+  full-row path (values, tie order) whenever the true top-k is inside
+  the candidate set — for EVERY candidate superset;
+- recall@10 ≥ 0.99 on the 2048-author synthetic gate graph at the
+  shipped default knobs (the ISSUE acceptance floor);
+- delta staleness: an updated row is answered exactly, never from the
+  stale index, and the refresh restores ANN answering with the index
+  epoch advanced to the service's consistency token;
+- the packed index round-trips through its artifact, rejects
+  wrong-graph artifacts by fingerprint, and pad slots can never
+  surface as candidates;
+- NeuralPathSim.topk_rerank shares the exact-rerank primitives (oracle
+  tie order included);
+- the ann-smoke wiring (tier-1's `make ann-smoke`).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from distributed_pathsim_tpu.backends.base import create_backend
+from distributed_pathsim_tpu.data.delta import (
+    DeltaBatch,
+    edge_delta,
+    with_headroom,
+)
+from distributed_pathsim_tpu.data.synthetic import synthetic_hin
+from distributed_pathsim_tpu.index import CentroidIndex, IndexMismatch, build_index
+from distributed_pathsim_tpu.index.build import (
+    half_chain_and_denominators,
+    struct_embeddings,
+)
+from distributed_pathsim_tpu.ops import pathsim
+from distributed_pathsim_tpu.ops.metapath import compile_metapath
+from distributed_pathsim_tpu.serving import PathSimService, ServeConfig
+
+
+@pytest.fixture(scope="module")
+def small():
+    hin = synthetic_hin(300, 520, 12, seed=7)
+    mp = compile_metapath("APVPA", hin.schema)
+    c, d = half_chain_and_denominators(hin, mp)
+    return hin, mp, c, d
+
+
+def _ann_service(hin, mp, backend="numpy", **cfg):
+    cfg.setdefault("max_wait_ms", 0.5)
+    cfg.setdefault("warm", False)
+    cfg.setdefault("topk_mode", "ann")
+    cfg.setdefault("ann_shadow_every", 0)
+    return PathSimService(
+        create_backend(backend, hin, mp), config=ServeConfig(**cfg)
+    )
+
+
+# -- candidate-restricted primitives (ops/pathsim) -------------------------
+
+
+def test_candidate_scoring_bit_identical_for_any_superset(small):
+    """For random candidate supersets CONTAINING the true top-k, the
+    candidate primitives return exactly the full-row answer — values
+    and (descending score, ascending column) tie order."""
+    hin, mp, c, d = small
+    backend = create_backend("numpy", hin, mp)
+    rng = np.random.default_rng(3)
+    n = c.shape[0]
+    k = 10
+    for row in rng.integers(0, n, size=12):
+        ev, ei = backend.topk_row(int(row), k=k)
+        true_idx = ei[np.isfinite(ev)]
+        for extra in (0, 5, 60):
+            pool = rng.choice(n, size=extra, replace=False)
+            cand = np.unique(np.concatenate([true_idx, pool]))
+            cand = cand[cand != row]
+            counts = c[cand] @ c[int(row)]
+            scores = pathsim.score_candidates(
+                counts[None, :], np.asarray([d[int(row)]]),
+                d[cand][None, :],
+            )
+            vals, idxs = pathsim.topk_from_candidate_scores(
+                scores, cand[None, :], k
+            )
+            np.testing.assert_array_equal(vals[0], ev)
+            np.testing.assert_array_equal(idxs[0], ei)
+
+
+def test_candidate_primitives_drop_pads_and_dedupe():
+    scores = np.array([[0.5, 0.9, 0.9, 0.1, 0.7]])
+    cols = np.array([[3, 7, 7, -1, 2]])
+    vals, idxs = pathsim.topk_from_candidate_scores(scores, cols, 4)
+    # col 7 deduped, pad dropped, order (desc score, asc col)
+    np.testing.assert_array_equal(idxs[0], [7, 2, 3, 0])
+    np.testing.assert_array_equal(
+        vals[0], [0.9, 0.7, 0.5, -np.inf]
+    )
+
+
+# -- the index itself ------------------------------------------------------
+
+
+def test_index_pads_never_surface(small):
+    hin, mp, c, d = small
+    idx = build_index(c=c, d=d, metapath=mp, n_centroids=9)
+    rows = np.arange(8, dtype=np.int64)
+    sims, mem = idx.probe_batch(rows, nprobe=3)
+    # every −inf slot is a pad or self; everything selected is a real id
+    for b in range(8):
+        cand = idx.select_candidates(sims[b], mem[b], 50)
+        assert np.all(cand >= 0)
+        assert int(rows[b]) not in cand.tolist()
+    mem2, top_c = idx.route_batch(rows, nprobe=3)
+    for b in range(8):
+        live = mem2[b][mem2[b] >= 0]
+        assert int(rows[b]) not in live.tolist()
+        assert live.size == np.unique(live).size  # one slot per node
+
+
+def test_index_every_node_packed_exactly_once(small):
+    hin, mp, c, d = small
+    idx = build_index(c=c, d=d, metapath=mp, n_centroids=13)
+    packed_ids = idx.members[idx.members >= 0]
+    assert sorted(packed_ids.tolist()) == list(range(idx.n))
+    # the slot map agrees with the blocks
+    rows = np.arange(idx.n, dtype=np.int64)
+    emb = idx.embedding_of(rows)
+    assert np.all(
+        idx.members[idx.cluster_of[rows], idx.slot_of[rows]] == rows
+    )
+    assert emb.shape == (idx.n, idx.dim)
+
+
+def test_index_save_load_roundtrip_and_fingerprint_guard(small, tmp_path):
+    hin, mp, c, d = small
+    idx = build_index(
+        c=c, d=d, metapath=mp, n_centroids=9, token=("fp-a", 0)
+    )
+    path = str(tmp_path / "idx.npz")
+    idx.save(path)
+    back = CentroidIndex.load(path, expect_base_fp="fp-a")
+    np.testing.assert_array_equal(back.members, idx.members)
+    np.testing.assert_array_equal(back.packed, idx.packed)
+    assert back.token == ("fp-a", 0)
+    assert back.meta["embedding"] == "struct"
+    with pytest.raises(IndexMismatch):
+        CentroidIndex.load(path, expect_base_fp="fp-OTHER")
+
+
+def test_cluster_cap_feasibility_raise(small):
+    hin, mp, c, d = small
+    idx = build_index(c=c, d=d, metapath=mp, n_centroids=4,
+                      cluster_cap=8)  # 4 * 8 < 300: must be raised
+    assert idx.cluster_cap * idx.n_centroids >= idx.n
+    assert idx.meta["cap_raised_from"] == 8
+
+
+def test_refresh_rows_moves_and_clears_staleness(small):
+    hin, mp, c, d = small
+    idx = build_index(c=c, d=d, metapath=mp, n_centroids=9)
+    rows = np.asarray([5, 17, 100])
+    assert idx.mark_stale(rows) == 3
+    assert not idx.covers(5) and idx.stale_count == 3
+    emb = struct_embeddings(
+        c, d,
+        quad=(np.asarray(idx.meta["quad_t"]),
+              np.asarray(idx.meta["quad_w"])),
+        max_dim=int(idx.meta["max_dim"]),
+    )[rows]
+    unplaced = idx.refresh_rows(rows, emb, token=("fp", 3))
+    assert unplaced == []
+    assert idx.stale_count == 0 and idx.covers(5)
+    assert idx.token == ("fp", 3)
+    # appended-past-build rows are reported, not silently dropped
+    unplaced = idx.refresh_rows(
+        np.asarray([idx.n + 2]), np.zeros((1, idx.dim), np.float32)
+    )
+    assert unplaced == [idx.n + 2]
+
+
+# -- serving: recall gate, bit parity, staleness ---------------------------
+
+
+def test_recall_gate_2048_default_knobs():
+    """The ISSUE acceptance floor: recall@10 ≥ 0.99 on the 2048-author
+    synthetic graph at the shipped default knobs (score recall — ties
+    at the k boundary count; the strict id recall is asserted ≥ 0.97
+    so a silent index regression still fails loudly)."""
+    hin = synthetic_hin(2048, 4096, 48, seed=0)
+    mp = compile_metapath("APVPA", hin.schema)
+    svc = _ann_service(hin, mp)
+    try:
+        c, d = half_chain_and_denominators(hin, mp)
+        rng = np.random.default_rng(1)
+        eligible = np.flatnonzero(d > 0)
+        rows = rng.choice(eligible, size=96, replace=False)
+        sc_recalls, id_recalls = [], []
+        for row in rows:
+            av, ai = svc.topk_index(int(row), k=10, mode="ann")
+            ev, ei = svc.topk_index(int(row), k=10, mode="exact")
+            want = ei[np.isfinite(ev)]
+            kth = min(v for v in ev if np.isfinite(v))
+            got_v = av[np.isfinite(av)]
+            got_i = {int(i) for i in ai[np.isfinite(av)]}
+            sc_recalls.append(
+                min(float((got_v >= kth).sum()) / want.size, 1.0)
+            )
+            id_recalls.append(
+                sum(1 for i in want if int(i) in got_i) / want.size
+            )
+        assert float(np.mean(sc_recalls)) >= 0.99
+        assert float(np.mean(id_recalls)) >= 0.97
+    finally:
+        svc.close()
+
+
+@pytest.mark.parametrize("variant", ["rerank-all", "shortlist"])
+def test_ann_bit_identical_when_covered(small, variant):
+    """Whenever the ann answer's index set equals the exact answer's,
+    the two are bit-identical (values AND order) — both variants."""
+    hin, mp, c, d = small
+    svc = _ann_service(hin, mp, ann_variant=variant)
+    try:
+        rng = np.random.default_rng(5)
+        eligible = np.flatnonzero(d > 0)
+        covered = 0
+        for row in rng.choice(eligible, size=32, replace=False):
+            av, ai = svc.topk_index(int(row), k=10, mode="ann")
+            ev, ei = svc.topk_index(int(row), k=10, mode="exact")
+            if set(ai.tolist()) == set(ei.tolist()):
+                covered += 1
+                np.testing.assert_array_equal(av, ev)
+                np.testing.assert_array_equal(ai, ei)
+        assert covered > 0  # the assertion must have bitten
+    finally:
+        svc.close()
+
+
+def test_delta_staleness_answers_exactly_then_refresh():
+    """The staleness contract: an updated row is NEVER answered from
+    the stale index — it falls back to the exact path (counted) until
+    refresh re-embeds it; refresh advances the index epoch to the
+    service token and restores ANN answering."""
+    hin = with_headroom(synthetic_hin(300, 520, 12, seed=7), 0.25)
+    mp = compile_metapath("APVPA", hin.schema)
+    svc = _ann_service(hin, mp, ann_auto_refresh=False)
+    try:
+        ap = svc.hin.blocks["author_of"]
+        row, col = int(ap.rows[0]), int(ap.cols[0])
+        delta = DeltaBatch(edges=(
+            edge_delta("author_of", add=(), remove=[(row, col)]),
+        ),)
+        info = svc.update(delta)
+        assert info["mode"] == "delta"
+        assert info["ann_stale_rows"] > 0
+        assert svc._ann.index.stale[row]
+        # index epoch now LAGS the service token (that is what health
+        # advertises to the router)
+        assert svc.health()["index"]["epoch"] != list(
+            svc.consistency_token
+        )
+        fb0 = _fallbacks("stale")
+        av, ai = svc.topk_index(row, k=10, mode="ann")
+        ev, ei = svc.topk_index(row, k=10, mode="exact")
+        np.testing.assert_array_equal(av, ev)
+        np.testing.assert_array_equal(ai, ei)
+        assert _fallbacks("stale") > fb0
+        r = svc.refresh_index()
+        assert r["stale_remaining"] == 0
+        assert svc.health()["index"]["epoch"] == list(
+            svc.consistency_token
+        )
+        av2, ai2 = svc.topk_index(row, k=10, mode="ann")
+        # refreshed: answered via ann again, and still oracle-exact
+        # (this row's candidates easily cover on a 300-node graph)
+        np.testing.assert_array_equal(ai2, ei)
+    finally:
+        svc.close()
+
+
+def _fallbacks(reason: str) -> float:
+    from distributed_pathsim_tpu.obs.metrics import get_registry
+
+    return get_registry().counter(
+        "dpathsim_ann_fallbacks_total",
+        "ann-requested queries answered exactly instead, by reason",
+    ).labels(reason=reason).value
+
+
+def test_mode_fallbacks_counted(small):
+    hin, mp, c, d = small
+    exact_svc = PathSimService(
+        create_backend("numpy", hin, mp),
+        config=ServeConfig(max_wait_ms=0.5, warm=False),
+    )
+    try:
+        before = _fallbacks("no_index")
+        vals, idxs = exact_svc.topk_index(3, k=5, mode="ann")
+        assert _fallbacks("no_index") > before  # served exactly instead
+        ev, ei = exact_svc.topk_index(3, k=5, mode="exact")
+        np.testing.assert_array_equal(vals, ev)
+        with pytest.raises(ValueError):
+            exact_svc.topk_index(3, k=5, mode="bogus")
+    finally:
+        exact_svc.close()
+
+
+def test_shadow_confidence_gate_trips(small):
+    """A broken index (shadow recall under the floor) flips the service
+    to exact-only: the low_confidence fallback, reset by refresh."""
+    hin, mp, c, d = small
+    svc = _ann_service(hin, mp, ann_shadow_every=1, ann_min_shadow=2,
+                       ann_recall_floor=1.01)  # unreachable floor
+    try:
+        rng = np.random.default_rng(2)
+        eligible = np.flatnonzero(d > 0)
+        for row in rng.choice(eligible, size=8, replace=False):
+            svc.topk_index(int(row), k=10, mode="ann")
+        assert not svc._ann.enabled  # the gate tripped
+        before = _fallbacks("low_confidence")
+        svc.topk_index(int(eligible[0]), k=10, mode="ann")
+        assert _fallbacks("low_confidence") > before
+        svc.refresh_index()
+        assert svc._ann.enabled  # fresh evidence, fresh gate
+    finally:
+        svc.close()
+
+
+def test_neural_topk_rerank_oracle_tie_order():
+    """The neural CLI's rerank now shares the serving primitives: its
+    answer equals the exact engine's top-k (tie order included) when
+    the candidate pool covers it."""
+    from distributed_pathsim_tpu.models.neural import NeuralPathSim
+
+    hin = synthetic_hin(220, 380, 10, seed=4)
+    mp = compile_metapath("APVPA", hin.schema)
+    model = NeuralPathSim(hin, mp, dim=16, hidden=32)
+    backend = create_backend("numpy", hin, mp)
+    rng = np.random.default_rng(0)
+    checked = 0
+    for row in rng.integers(0, 220, size=10):
+        got = model.topk_rerank(int(row), k=10, candidates=219)
+        ev, ei = backend.topk_row(int(row), k=10)
+        want = [
+            (int(i), float(v)) for v, i in zip(ev, ei) if np.isfinite(v)
+        ]
+        # candidates=N−1 ⇒ full coverage ⇒ must match exactly
+        assert got == want
+        checked += 1
+    assert checked == 10
+
+
+def test_index_cli_build_and_probe(tmp_path, capsys):
+    from distributed_pathsim_tpu.index.cli import index_main
+
+    out = str(tmp_path / "idx.npz")
+    rc = index_main([
+        "build", "--dataset",
+        "synthetic:authors=200,papers=340,venues=8,seed=3",
+        "--out", out,
+    ])
+    assert rc == 0
+    import json
+
+    capsys.readouterr()  # drop the build payload
+    rc = index_main([
+        "probe", "--index", out, "--row", "5", "--k", "5",
+        "--dataset", "synthetic:authors=200,papers=340,venues=8,seed=3",
+    ])
+    assert rc == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["row"] == 5
+    assert payload["n_candidates"] > 0
+    # exact-reranked scores are the serving answer for this row
+    hin = synthetic_hin(200, 340, 8, seed=3)
+    mp = compile_metapath("APVPA", hin.schema)
+    backend = create_backend("numpy", hin, mp)
+    ev, ei = backend.topk_row(5, k=5)
+    want = [int(i) for v, i in zip(ev, ei) if np.isfinite(v)]
+    got = [h["row"] for h in payload["topk"]]
+    assert got == want[: len(got)]
+
+
+def test_ann_router_worker_flags_forward():
+    """Router CLI forwards the ann flags to worker children."""
+    from distributed_pathsim_tpu.router.cli import (
+        _worker_argv, build_router_parser,
+    )
+
+    args = build_router_parser().parse_args([
+        "--workers", "2", "--topk-mode", "ann", "--ann-nprobe", "4",
+        "--ann-variant", "shortlist",
+    ])
+    argv = _worker_argv(args, 0)
+    assert "--topk-mode" in argv and "ann" in argv
+    assert "--ann-nprobe" in argv and "4" in argv
+    assert "--ann-variant" in argv and "shortlist" in argv
+
+
+def test_bench_ann_smoke():
+    """`make ann-smoke`, wired non-slow (tier-1): recall gate, zero
+    steady-state recompiles, staleness fallback exercised, zero shed."""
+    import bench_serving
+
+    result = bench_serving.run_ann_smoke()
+    assert all(result["smoke_checks"].values())
