@@ -4,7 +4,8 @@
 PYTHON ?= python
 
 .PHONY: test chaos chaos-router serve-smoke update-smoke obs-smoke \
-	router-smoke ann-smoke lint-telemetry tune-smoke lint-tuning tune
+	router-smoke ann-smoke fleet-obs-smoke lint-telemetry tune-smoke \
+	lint-tuning tune
 
 # Tier-1: the fast CPU suite (the driver's acceptance gate).
 test:
@@ -76,6 +77,19 @@ ann-smoke:
 # pytest (tests/test_obs.py::test_bench_obs_smoke), so tier-1 covers it.
 obs-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) bench_serving.py --regime obs --smoke
+
+# Fleet observability smoke: a real router + 2 worker subprocesses
+# under closed-loop load with one mid-load SIGKILL. Hard gates: >=1
+# stitched cross-process trace with zero broken parent links, merged
+# fleet histogram count == sum of per-worker counts (exact merge, end
+# to end), SLO burn-rate fires on an injected latency fault, flight
+# recorder captured the failed-over requests, zero lost requests and
+# zero added steady-state compiles on the survivor, per-worker
+# artifact forwarding left suffixed files. The same run is wired as a
+# non-slow pytest (tests/test_fleet_obs.py::test_bench_fleet_obs_smoke),
+# so tier-1 covers it.
+fleet-obs-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) bench_serving.py --regime fleet-obs --smoke
 
 # Telemetry discipline: no wall-clock durations, no raw stderr prints
 # in library code, no event-sink bypasses. Also a non-slow pytest

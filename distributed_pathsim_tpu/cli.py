@@ -191,14 +191,20 @@ def main(argv: list[str] | None = None) -> int:
             msg = exc.args[0] if exc.args else exc
             print(f"error: {msg}", file=sys.stderr)
             return 1
-    if argv and argv[0] in ("worker", "router"):
+    if argv and argv[0] in ("worker", "router", "fleet-stats"):
         # ``dpathsim worker`` — one serving replica speaking the
         # router-facing async protocol; ``dpathsim router`` — the
-        # fault-tolerant fan-out over N of them (router/cli.py).
-        from .router.cli import router_main, worker_main
+        # fault-tolerant fan-out over N of them; ``dpathsim
+        # fleet-stats`` — the one-shot merged-fleet summary
+        # (router/cli.py).
+        from .router.cli import fleet_stats_main, router_main, worker_main
 
         try:
-            entry = worker_main if argv[0] == "worker" else router_main
+            entry = {
+                "worker": worker_main,
+                "router": router_main,
+                "fleet-stats": fleet_stats_main,
+            }[argv[0]]
             return entry(argv[1:])
         except (KeyError, ValueError, FileNotFoundError) as exc:
             msg = exc.args[0] if exc.args else exc

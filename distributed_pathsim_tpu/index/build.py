@@ -220,10 +220,18 @@ def refresh_embeddings(
         np.asarray(index.meta["quad_t"]), np.asarray(index.meta["quad_w"])
     )
     rows = np.asarray(rows, dtype=np.int64)
+    from ..obs.trace import get_tracer
+
     # φ is row-local given the pinned grid, so only the affected rows'
-    # C/d slices are embedded — the refresh stays O(Δ), not O(N)
-    return struct_embeddings(
-        np.asarray(c)[rows], np.asarray(d)[rows], quad=quad,
-        max_dim=int(index.meta.get("max_dim", 1024)),
-        seed=int(index.meta.get("seed", 0)),
-    )
+    # C/d slices are embedded — the refresh stays O(Δ), not O(N). The
+    # span parents into the refresh trace (the background ann.refresh
+    # root, or a protocol refresh_index's serve.op), so the fleet
+    # export shows where refresh time goes per delta.
+    with get_tracer().child_span(
+        "index.refresh_embed", rows=int(rows.shape[0])
+    ):
+        return struct_embeddings(
+            np.asarray(c)[rows], np.asarray(d)[rows], quad=quad,
+            max_dim=int(index.meta.get("max_dim", 1024)),
+            seed=int(index.meta.get("seed", 0)),
+        )

@@ -450,6 +450,18 @@ class CentroidIndex:
         stay on the exact path until a rebuild."""
         rows = np.asarray(rows, dtype=np.int64)
         emb = np.asarray(emb, dtype=np.float32)
+        from ..obs.trace import get_tracer
+
+        span = get_tracer().child_span(
+            "index.refresh_rows", n=int(rows.shape[0])
+        )
+        with span:
+            return self._refresh_rows(rows, emb, token)
+
+    def _refresh_rows(
+        self, rows: np.ndarray, emb: np.ndarray,
+        token: tuple[str, int] | None,
+    ) -> list[int]:
         unplaced: list[int] = []
         for i, row in enumerate(rows):
             row = int(row)

@@ -1,16 +1,27 @@
 """Observability: hierarchical tracing spans, metrics, exporters.
 
-See DESIGN.md §20. Public surface:
+See DESIGN.md §20 (single process) and §24 (fleet). Public surface:
 
 - :func:`get_tracer` / :class:`Tracer` / :class:`SpanContext` —
   hierarchical spans with cross-thread context propagation, exportable
-  as Chrome/Perfetto trace-event JSON (trace.py);
+  as Chrome/Perfetto trace-event JSON (trace.py); :func:`to_wire` /
+  :func:`from_wire` carry span identity + the sampling decision across
+  process boundaries on the JSONL protocol;
 - :func:`get_registry` / :class:`MetricsRegistry` — process-wide
   counters, gauges, and bounded-memory streaming histograms
   (metrics.py);
 - :func:`render_prometheus` / :func:`write_textfile` /
   :class:`PrometheusTextfileExporter` / :func:`write_chrome_trace` —
   the on-disk/wire formats (export.py);
+- fleet.py — exact (bucket-wise, associative) merge of per-process
+  registry snapshots, per-worker-labeled fleet Prometheus export,
+  stitched multi-process Perfetto traces + the parent-link audit;
+- :class:`SLOEngine` / :class:`SLOSpec` (slo.py) — declarative
+  objectives over the merged stream with multi-window burn-rate
+  alerts;
+- :class:`FlightRecorder` (flight.py) — the tail-sampling complement
+  to head sampling: retroactively keep slow/errored/shed/hedged/
+  failed-over requests' records and span trees;
 - :func:`configure` — the one switch the CLIs and benches flip.
 
 Layering: this package imports nothing from the rest of
@@ -26,28 +37,66 @@ from .export import (
     write_chrome_trace,
     write_textfile,
 )
+from .fleet import (
+    FleetTextfileExporter,
+    audit_fleet_traces,
+    fleet_chrome_trace,
+    merge_histogram_cells,
+    merge_registry_snapshots,
+    render_fleet_prometheus,
+    render_fleet_stats,
+    write_fleet_textfile,
+    write_fleet_trace,
+)
+from .flight import FlightRecorder
 from .metrics import (
     MetricsRegistry,
     geometric_bounds,
     get_registry,
+    quantile_from_counts,
     set_registry,
 )
-from .trace import Span, SpanContext, Tracer, get_tracer
+from .slo import SLOEngine, SLOSpec, default_specs, specs_from_json
+from .trace import (
+    Span,
+    SpanContext,
+    Tracer,
+    from_wire,
+    get_tracer,
+    to_wire,
+)
 
 __all__ = [
+    "FleetTextfileExporter",
+    "FlightRecorder",
     "MetricsRegistry",
     "PrometheusTextfileExporter",
+    "SLOEngine",
+    "SLOSpec",
     "Span",
     "SpanContext",
     "Tracer",
+    "audit_fleet_traces",
     "configure",
+    "default_specs",
     "dump_trace",
+    "fleet_chrome_trace",
+    "from_wire",
     "geometric_bounds",
     "get_registry",
     "get_tracer",
+    "merge_histogram_cells",
+    "merge_registry_snapshots",
+    "quantile_from_counts",
+    "render_fleet_prometheus",
+    "render_fleet_stats",
     "render_prometheus",
     "set_registry",
+    "specs_from_json",
+    "to_wire",
     "write_chrome_trace",
+    "write_fleet_textfile",
+    "write_fleet_trace",
     "write_textfile",
 ]
 

@@ -50,6 +50,17 @@ def _fmt_value(v: float) -> str:
     return repr(float(v))
 
 
+def atomic_write(path: str, text: str) -> None:
+    """THE atomic file write: temp file in the destination directory
+    (``os.replace`` must not cross filesystems) + rename, so no reader
+    — a textfile collector, a trace viewer, a dump raced by SIGTERM —
+    can ever see half a file. Every obs artifact goes through here."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        f.write(text)
+    os.replace(tmp, path)
+
+
 def render_prometheus(registry: MetricsRegistry | None = None) -> str:
     """Prometheus text exposition format 0.0.4. Histograms render with
     cumulative ``le`` buckets (underflow folds into the first bound,
@@ -94,42 +105,40 @@ def render_prometheus(registry: MetricsRegistry | None = None) -> str:
 def write_textfile(
     path: str, registry: MetricsRegistry | None = None
 ) -> None:
-    """One atomic Prometheus snapshot: temp file + rename. The temp
-    file lives in the destination directory (``os.replace`` must not
-    cross filesystems)."""
-    text = render_prometheus(registry)
-    tmp = f"{path}.tmp.{os.getpid()}"
-    with open(tmp, "w", encoding="utf-8") as f:
-        f.write(text)
-    os.replace(tmp, path)
+    """One atomic Prometheus snapshot (see :func:`atomic_write`)."""
+    atomic_write(path, render_prometheus(registry))
 
 
-class PrometheusTextfileExporter:
-    """Background interval writer for the textfile-collector pattern.
+class IntervalFileExporter:
+    """The interval-writer lifecycle, shared by the per-process and
+    fleet exporters: a daemon thread calls :meth:`write` every
+    ``interval_s`` (plus once at start, so the file is visible
+    immediately), swallowing transient OSErrors (metrics export must
+    never take the server down — the next interval retries); ``stop()``
+    performs a final write so the file always reflects the process's
+    last state. Start/stop are idempotent. Subclasses implement
+    :meth:`write`."""
 
-    A daemon thread calls :func:`write_textfile` every ``interval_s``;
-    ``stop()`` performs a final write so the file always reflects the
-    process's last state. Start/stop are idempotent."""
+    thread_name = "pathsim-export"
 
-    def __init__(
-        self,
-        path: str,
-        interval_s: float = 5.0,
-        registry: MetricsRegistry | None = None,
-    ):
-        self.path = path
+    def __init__(self, interval_s: float = 5.0):
         self.interval_s = float(interval_s)
-        self._registry = registry
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
-    def start(self) -> "PrometheusTextfileExporter":
+    def write(self) -> None:  # pragma: no cover - overridden
+        raise NotImplementedError
+
+    def start(self):
         if self._thread is not None:
             return self
         self._stop.clear()
-        write_textfile(self.path, self._registry)  # visible immediately
+        # the immediate first write is LOUD: an unwritable path is a
+        # config error the operator must see at startup, not a file
+        # that silently never appears
+        self.write()
         self._thread = threading.Thread(
-            target=self._loop, name="pathsim-metrics-export", daemon=True
+            target=self._loop, name=self.thread_name, daemon=True
         )
         self._thread.start()
         return self
@@ -137,11 +146,8 @@ class PrometheusTextfileExporter:
     def _loop(self) -> None:
         while not self._stop.wait(self.interval_s):
             try:
-                write_textfile(self.path, self._registry)
+                self.write()
             except OSError:
-                # Transient write failure (disk full, dir vanished):
-                # metrics export must never take the server down; the
-                # next interval retries.
                 pass
 
     def stop(self) -> None:
@@ -151,15 +157,35 @@ class PrometheusTextfileExporter:
         self._thread.join(timeout=10)
         self._thread = None
         try:
-            write_textfile(self.path, self._registry)
+            self.write()  # shutdown state preserved
         except OSError:
             pass
 
-    def __enter__(self) -> "PrometheusTextfileExporter":
+    def __enter__(self):
         return self.start()
 
     def __exit__(self, *exc) -> None:
         self.stop()
+
+
+class PrometheusTextfileExporter(IntervalFileExporter):
+    """Background interval writer for the textfile-collector pattern
+    (``--metrics-file`` on the per-process CLIs)."""
+
+    thread_name = "pathsim-metrics-export"
+
+    def __init__(
+        self,
+        path: str,
+        interval_s: float = 5.0,
+        registry: MetricsRegistry | None = None,
+    ):
+        super().__init__(interval_s)
+        self.path = path
+        self._registry = registry
+
+    def write(self) -> None:
+        write_textfile(self.path, self._registry)
 
 
 def write_chrome_trace(path: str, tracer: Tracer | None = None) -> int:

@@ -63,6 +63,50 @@ def geometric_bounds(
     return tuple(bounds)
 
 
+def quantile_from_counts(
+    bounds: tuple[float, ...],
+    counts: list[int],
+    underflow: int,
+    count: int,
+    vmin: float,
+    vmax: float,
+    q: float,
+) -> float:
+    """The quantile estimator as a pure function of bucket state —
+    the ONE implementation, shared by live cells and by merged fleet
+    snapshots (obs/fleet.py), so a quantile computed at the router from
+    exactly-merged buckets is the same number the worker would have
+    reported for the same samples. Log-linear interpolation inside the
+    landing bucket, clamped to the observed min/max; tail-INCLUSIVE
+    nearest-rank convention (target = q·count) — see
+    ``_HistogramCell.quantile`` for why the strict walk under-reports
+    discrete tails."""
+    if count == 0:
+        return math.nan
+    target = q * count
+    cum = float(underflow)
+    if target <= cum:
+        # inside the underflow bucket: all we know is [min, lo]
+        return vmin
+    prev_bound = bounds[0]
+    for i, c in enumerate(counts):
+        if c:
+            if target <= cum + c:
+                frac = (target - cum) / c
+                blo = max(prev_bound, vmin)
+                bhi = min(bounds[i], vmax)
+                if blo >= bhi:
+                    return bhi
+                # log-linear: geometric buckets make log-space
+                # interpolation the unbiased choice
+                return math.exp(
+                    math.log(blo) + frac * (math.log(bhi) - math.log(blo))
+                )
+            cum += c
+        prev_bound = bounds[i]
+    return vmax  # overflow bucket
+
+
 class _HistogramCell:
     """One label set's streaming distribution. Bounded memory: bucket
     counts + scalar aggregates, never samples."""
@@ -126,31 +170,10 @@ class _HistogramCell:
         1 s request would report p99 ≈ 1 ms, a 1000× under-report of
         exactly the signal a latency quantile exists to surface."""
         with self._lock:
-            if self.count == 0:
-                return math.nan
-            target = q * self.count
-            cum = float(self.underflow)
-            if target <= cum:
-                # inside the underflow bucket: all we know is [min, lo]
-                return self.min
-            prev_bound = self.bounds[0]
-            for i, c in enumerate(self.counts):
-                if c:
-                    if target <= cum + c:
-                        frac = (target - cum) / c
-                        blo = max(prev_bound, self.min)
-                        bhi = min(self.bounds[i], self.max)
-                        if blo >= bhi:
-                            return bhi
-                        # log-linear: geometric buckets make log-space
-                        # interpolation the unbiased choice
-                        return math.exp(
-                            math.log(blo)
-                            + frac * (math.log(bhi) - math.log(blo))
-                        )
-                    cum += c
-                prev_bound = self.bounds[i]
-            return self.max  # overflow bucket
+            return quantile_from_counts(
+                self.bounds, self.counts, self.underflow, self.count,
+                self.min, self.max, q,
+            )
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -372,6 +395,12 @@ class MetricsRegistry:
             out[fam.name] = {
                 "type": fam.kind, "help": fam.help, "values": values
             }
+            if fam.kind == "histogram":
+                # bucket geometry rides the snapshot: an exact merge at
+                # the router (obs/fleet.py) is only defined over cells
+                # sharing edges, and the merge must be able to CHECK
+                # that instead of assuming it
+                out[fam.name]["bounds"] = list(fam.bounds)
         return out
 
     def reset(self) -> None:

@@ -34,6 +34,19 @@ tracing a long-lived server must never grow without bound. Disabled
 (the default), ``span()`` costs one attribute check; the serving hot
 path stays unmeasurable.
 
+Cross-PROCESS propagation (the fleet tier, DESIGN.md §24): span ids are
+globally unique — each tracer draws a random 48-bit id base at init, so
+a router process and its worker subprocesses can never mint colliding
+ids — and a span's identity travels on the JSONL protocol as a tiny
+``trace`` dict (:func:`to_wire` / :func:`from_wire`). The receiving
+process activates the wire context and every span it opens parents into
+the ORIGINATING process's trace; merging the per-process rings
+(:func:`obs.fleet.fleet_chrome_trace`) yields one stitched Perfetto
+timeline. The wire context also carries the HEAD sampling decision:
+``{"sampled": false}`` tells the receiver to create zero spans for this
+request (the dropped-head sentinel travels with the request), so the
+configured 1/N rate holds fleet-wide instead of per process.
+
 Head-based sampling (``sample_every``): span bookkeeping is
 GIL-serialized Python, so tracing EVERY request costs tens of
 microseconds of serialized work per request — fine for debugging, too
@@ -79,8 +92,33 @@ class SpanContext:
 # descendants of a dropped head (suppressed outright) rather than as
 # fresh heads — otherwise every nested "root" would tick the sampler
 # again and the configured 1/N rate would not hold. Real ids start at
-# 1, so (0, 0) can never collide with a live span.
+# a positive id base, so (0, 0) can never collide with a live span.
 _DROPPED = SpanContext(0, 0)
+
+
+def to_wire(ctx: "SpanContext | None", sampled: bool = True) -> dict:
+    """A span context as the protocol's ``trace`` field. ``ctx=None``
+    with ``sampled=False`` propagates a dropped-head decision (the
+    receiver must create no spans); ``ctx=None`` with ``sampled=True``
+    is an empty dict — "no opinion", the receiver traces on its own."""
+    if ctx is None or ctx is _DROPPED:
+        return {"sampled": False} if not sampled or ctx is _DROPPED else {}
+    return {"trace_id": int(ctx.trace_id), "span_id": int(ctx.span_id)}
+
+
+def from_wire(trace: dict | None) -> SpanContext | None:
+    """Parse a protocol ``trace`` field back into the context to
+    ``activate()``. Returns None (no propagation — local behavior
+    unchanged), the dropped-head sentinel (``sampled: false`` — spans
+    suppressed downstream), or a live remote parent context."""
+    if not trace:
+        return None
+    if trace.get("sampled") is False:
+        return _DROPPED
+    tid, sid = trace.get("trace_id"), trace.get("span_id")
+    if tid is None or sid is None:
+        return None
+    return SpanContext(int(tid), int(sid))
 
 
 class Span:
@@ -128,6 +166,7 @@ class Span:
             "parent_id": self.parent_id,
             "t_start_ns": self.t_start_ns,
             "t_end_ns": self.t_end_ns,
+            "tid": self.tid,
             "thread": self.thread_name,
             "args": dict(self.args),
         }
@@ -149,6 +188,15 @@ class Tracer:
         self._lock = threading.Lock()
         self._spans: collections.deque[Span] = collections.deque(
             maxlen=max_spans
+        )
+        # Globally-unique ids: a random 48-bit base per tracer, local
+        # counter on top. Two processes of one fleet can never mint the
+        # same span id, so cross-process stitching (trace contexts on
+        # the wire, rings merged at export) needs no id translation.
+        # The base is strictly positive, so the (0, 0) dropped-head
+        # sentinel stays uncollidable.
+        self._id_base = (
+            (int.from_bytes(os.urandom(6), "big") | 1) << 24
         )
         self._ids = itertools.count(1)
         # root admissions seen, for deterministic head sampling
@@ -217,7 +265,7 @@ class Tracer:
         if parent is None and self.sample_every > 1:
             if next(self._root_seen) % self.sample_every:
                 return None
-        span_id = next(self._ids)
+        span_id = self._id_base + next(self._ids)
         if parent is None:
             trace_id, parent_id = span_id, None
         else:
@@ -354,6 +402,22 @@ class Tracer:
                 }
             )
         return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def export_state(self, limit: int | None = None) -> dict:
+        """The ring as JSON-safe state for cross-process merging: span
+        dicts, this process's pid, and the wall anchor that maps its
+        monotonic timestamps onto epoch µs (all fleet processes share
+        one host clock, so anchored timestamps align across exports).
+        ``limit`` keeps only the newest N spans — the ``trace`` protocol
+        op's payload must stay bounded on the wire."""
+        spans = self.spans()
+        if limit is not None and len(spans) > limit:
+            spans = spans[-limit:]
+        return {
+            "pid": os.getpid(),
+            "wall_anchor_us": self._wall_anchor_us,
+            "spans": [s.to_dict() for s in spans],
+        }
 
     def write_chrome_trace(self, path: str) -> int:
         """Dump the ring as Perfetto-loadable JSON (atomic rename —

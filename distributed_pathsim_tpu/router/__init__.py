@@ -18,7 +18,15 @@ ROADMAP open item 4). Public surface:
 - :class:`SubprocessTransport` / :class:`InprocTransport` — how the
   router reaches a worker: a real ``dpathsim worker`` child process, or
   an in-process thread for deterministic chaos tests (transport.py);
-- the ``dpathsim router`` / ``dpathsim worker`` subcommands (cli.py).
+- the ``dpathsim router`` / ``dpathsim worker`` / ``dpathsim
+  fleet-stats`` subcommands (cli.py).
+
+The router also hosts the fleet observability plane (DESIGN.md §24):
+cross-process trace stitching over the protocol's ``trace`` context,
+an exact (bucket-wise) merge of scraped per-worker metric registries,
+a multi-window burn-rate SLO engine over the merged stream, and a
+tail-sampled flight recorder for slow/errored/shed/hedged/failed-over
+requests (``flight_dump`` op + SIGTERM drain dump).
 """
 
 from .core import Router, RouterConfig, RouterShed

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import threading
 
@@ -195,11 +196,24 @@ _FORWARD_VALUE = (
     "cache_entries", "tile_cache_mb", "headroom", "delta_threshold",
     "tuning_table", "topk_mode", "index", "ann_nprobe", "ann_cand_mult",
     "ann_centroids", "ann_cluster_cap", "ann_variant",
-    "ann_shadow_every",
+    "ann_shadow_every", "metrics_interval", "trace_sample",
 )
 _FORWARD_TRUE = (
     "no_warm", "no_metrics", "no_tuning", "approx", "no_ann_refresh",
 )
+# artifact-path flags forwarded with a per-worker suffix: a fleet run
+# with --metrics-file/--trace-out/--metrics must leave N+1 artifacts
+# (one per process), not N processes clobbering one path — and a
+# worker left exporting to nowhere (the pre-§24 state: metrics enabled,
+# nothing exporting them) leaves nothing at all
+_FORWARD_PATH = ("metrics_file", "trace_out", "metrics")
+
+
+def _suffix_path(path: str, wid: str) -> str:
+    """``fleet.prom`` → ``fleet.w0.prom`` (suffix before the extension
+    so collectors globbing ``*.prom`` still pick every worker up)."""
+    root, ext = os.path.splitext(path)
+    return f"{root}.{wid}{ext}" if ext else f"{path}.{wid}"
 
 
 def build_router_parser() -> argparse.ArgumentParser:
@@ -230,6 +244,25 @@ def build_router_parser() -> argparse.ArgumentParser:
     p.add_argument("--deadline-ms", type=float, default=None,
                    help="default per-request budget when the client "
                    "sends none")
+    p.add_argument("--scrape-interval", type=float, default=5.0,
+                   help="seconds between fleet metrics scrapes (each "
+                   "worker's registry pulled and merged exactly; 0 "
+                   "disables the scrape loop and the SLO engine's "
+                   "periodic evaluation)")
+    p.add_argument("--slo-specs", default=None,
+                   help="JSON file of SLO specs (see DESIGN.md §24); "
+                   "default: built-in availability / p99-latency / "
+                   "update-visible / ann-recall objectives")
+    p.add_argument("--slow-ms", type=float, default=None,
+                   help="flight-recorder tail threshold: requests "
+                   "slower than this are kept; default: the latency "
+                   "SLO's p99 target")
+    p.add_argument("--flight-capacity", type=int, default=256,
+                   help="flight-recorder ring bound (records)")
+    p.add_argument("--flight-out", default=None,
+                   help="write the flight recording (records + kept "
+                   "span trees) here at drain/SIGTERM; the in-band "
+                   "'flight_dump' op dumps on demand")
     return p
 
 
@@ -241,6 +274,12 @@ def _worker_argv(args, index: int) -> list[str]:
         if val is None:
             continue
         argv += [f"--{name.replace('_', '-')}", str(val)]
+    for name in _FORWARD_PATH:
+        val = getattr(args, name)
+        if val is None:
+            continue
+        argv += [f"--{name.replace('_', '-')}",
+                 _suffix_path(str(val), f"w{index}")]
     for name in _FORWARD_TRUE:
         if getattr(args, name):
             argv.append(f"--{name.replace('_', '-')}")
@@ -302,14 +341,18 @@ def router_main(argv: list[str] | None = None) -> int:
     from .. import obs
     from ..resilience import preemption_handler
 
-    obs.configure(metrics=not args.no_metrics)
-    exporter = (
-        obs.PrometheusTextfileExporter(
-            args.metrics_file, interval_s=args.metrics_interval
-        )
-        if args.metrics_file
-        else None
+    # the router traces too: its root/dispatch spans are the trunk
+    # every worker subtree stitches into (fleet head sampling is the
+    # ROUTER's decision, propagated on the wire)
+    obs.configure(
+        metrics=not args.no_metrics,
+        tracing=True if args.trace_out else None,
+        trace_sample=args.trace_sample,
     )
+    slo_specs: tuple = ()
+    if args.slo_specs:
+        with open(args.slo_specs, encoding="utf-8") as f:
+            slo_specs = obs.specs_from_json(f.read())
     logger = RunLogger(output_path=None, echo=False,
                        metrics_path=args.metrics)
     set_event_sink(logger)
@@ -327,7 +370,28 @@ def router_main(argv: list[str] | None = None) -> int:
             heartbeat_miss_limit=args.heartbeat_miss,
             max_inflight=args.max_inflight,
             default_deadline_ms=args.deadline_ms,
+            scrape_interval_s=args.scrape_interval,
+            slo_specs=slo_specs,
+            slow_ms=args.slow_ms,
+            flight_capacity=args.flight_capacity,
         ),
+    )
+    # drain-time artifacts: written by Router.drain() while the
+    # workers can still answer the final span-ring scrape
+    router.flight_out = args.flight_out
+    router.fleet_trace_out = args.trace_out
+    # the router's --metrics-file is the FLEET export: every scraped
+    # worker's series with a worker label, atomically, plus the full
+    # fleet_metrics JSON beside it for `dpathsim fleet-stats`
+    exporter = (
+        obs.FleetTextfileExporter(
+            args.metrics_file,
+            router.metric_parts,
+            interval_s=args.metrics_interval,
+            snapshot_fn=lambda: router.fleet_metrics(refresh=False),
+        )
+        if args.metrics_file
+        else None
     )
     try:
         router.start()
@@ -341,6 +405,9 @@ def router_main(argv: list[str] | None = None) -> int:
         return router_loop(router, sys.stdin, sys.stdout)
     finally:
         runtime_event("router_exit", echo=False)
+        # a loop that exited without drain (EOF already drains; an
+        # exception might not) still owes the shutdown artifacts
+        router._shutdown_dumps()
         router.close()
         if exporter is not None:
             exporter.stop()
@@ -349,3 +416,43 @@ def router_main(argv: list[str] | None = None) -> int:
             preemption_handler.reset()
         set_event_sink(None)
         logger.close()
+
+
+def build_fleet_stats_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="dpathsim fleet-stats",
+        description="one-shot fleet summary (`top` for the router): "
+        "worker table, merged fleet-exact latency per op, headline "
+        "counters, SLO burn status",
+    )
+    p.add_argument(
+        "snapshot", nargs="?", default="-",
+        help="fleet metrics JSON: the file the router's --metrics-file "
+        "exporter writes beside the .prom (<file>.json), or '-' to "
+        "read a fleet_metrics response from stdin (e.g. piped from "
+        "`echo '{\"op\":\"fleet_metrics\"}' | dpathsim router ...`)",
+    )
+    p.add_argument("--json", action="store_true",
+                   help="re-emit the snapshot as JSON instead of the "
+                   "rendered table (for tooling)")
+    return p
+
+
+def fleet_stats_main(argv: list[str] | None = None) -> int:
+    from ..obs import render_fleet_stats
+
+    args = build_fleet_stats_parser().parse_args(argv)
+    if args.snapshot == "-":
+        data = json.load(sys.stdin)
+    else:
+        with open(args.snapshot, encoding="utf-8") as f:
+            data = json.load(f)
+    # accept a raw fleet_metrics result OR a protocol response envelope
+    if "merged" not in data and isinstance(data.get("result"), dict):
+        data = data["result"]
+    if args.json:
+        json.dump(data, sys.stdout, indent=2)
+        print()
+    else:
+        print(render_fleet_stats(data))
+    return 0

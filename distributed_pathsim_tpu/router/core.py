@@ -44,6 +44,20 @@ Chaos seams: ``heartbeat`` (a probe that never happened) and
 ``delta_broadcast`` (a worker missing an update) fire here;
 ``worker_dispatch`` fires in the worker (worker.py). See
 tests/test_router.py and ``make chaos-router``.
+
+**Fleet observability plane** (DESIGN.md §24): the router is where the
+fleet's N per-process truths become one. Every routed request gets a
+fleet-level root span whose per-attempt dispatch spans (primary /
+hedge / failover, siblings) carry their context to the workers on the
+wire — one stitched cross-process trace per request. The maintenance
+loop scrapes each worker's ``metrics`` op and merges the registries
+EXACTLY (same bucket edges ⇒ bucket-wise sums, obs/fleet.py), the SLO
+engine (obs/slo.py) evaluates declarative objectives over the merged
+stream with multi-window burn-rate alerts, and a tail-sampling flight
+recorder (obs/flight.py) retroactively keeps every slow / errored /
+shed / hedged / failed-over / ann-degraded request — dumped via the
+``flight_dump`` op and at SIGTERM drain, while the workers can still
+answer the final span-ring scrape.
 """
 
 from __future__ import annotations
@@ -54,7 +68,11 @@ import threading
 import time
 from concurrent.futures import Future
 
+from ..obs import fleet as obs_fleet
+from ..obs.flight import FlightRecorder
 from ..obs.metrics import get_registry
+from ..obs.slo import SLOEngine, default_specs
+from ..obs.trace import get_tracer, to_wire
 from ..resilience import Deadline, inject
 from ..utils.logging import runtime_event
 from .hashring import make_policy
@@ -91,13 +109,30 @@ class RouterConfig:
     # all-suspect blip — e.g. a stalled box starving every worker of
     # CPU for a second — must not turn into client-visible errors
     park_timeout_s: float = 10.0
+    # -- fleet observability (DESIGN.md §24) ---------------------------
+    # metrics scrape cadence: the maintenance loop pulls each worker's
+    # `metrics` op and merges the registries exactly (0 disables; the
+    # satellite artifact forwarding still leaves per-worker files)
+    scrape_interval_s: float = 5.0
+    # declarative SLO specs evaluated over the merged stream; () ships
+    # the defaults (availability / p99 latency / update-visible
+    # staleness / ann recall floor, obs/slo.py)
+    slo_specs: tuple = ()
+    # flight-recorder tail threshold: a request slower than this is
+    # kept even if nothing else went wrong. None derives it from the
+    # latency SLO's threshold (the p99 target IS the tail definition)
+    slow_ms: float | None = None
+    flight_capacity: int = 256
+    # span-ring scrape bound per worker (trace op payload)
+    trace_scrape_limit: int = 20_000
 
 
 class _WorkerState:
     __slots__ = (
         "wid", "transport", "status", "epoch", "queue_depth",
         "last_pong", "assigned", "catchup_active", "token",
-        "last_health", "pong_seq",
+        "last_health", "pong_seq", "last_metrics", "metrics_seq",
+        "metrics_mono", "trace_part", "trace_seq",
     )
 
     def __init__(self, wid: str, transport):
@@ -112,15 +147,25 @@ class _WorkerState:
         self.token: tuple[str, int] | None = None
         self.last_health: dict = {}
         self.pong_seq = 0
+        # fleet observability: the last scraped registry snapshot (the
+        # merge input), the last scraped span-ring export, and their
+        # reply sequence counters (waited scrapes poll on these)
+        self.last_metrics: dict | None = None
+        self.metrics_seq = 0
+        self.metrics_mono = 0.0
+        self.trace_part: dict | None = None
+        self.trace_seq = 0
 
 
 class _Pending:
     __slots__ = (
         "rid", "req", "key", "row", "future", "deadline", "tried",
         "assigned", "hedged", "hedge_sent", "t0", "failovers", "parked",
+        "span", "attempt_spans",
     )
 
-    def __init__(self, rid: str, req: dict, key, row, future, deadline):
+    def __init__(self, rid: str, req: dict, key, row, future, deadline,
+                 span=None):
         self.rid = rid
         self.req = req
         self.key = key
@@ -134,6 +179,13 @@ class _Pending:
         self.failovers = 0
         self.parked = False
         self.t0 = time.monotonic()
+        # tracing: the fleet-level root span and one child span per
+        # dispatch ATTEMPT (primary / hedge / failover) — siblings
+        # under the root, each carried to a worker on the wire so its
+        # subtree grows there. None when tracing is off or this
+        # request's head was sampled out.
+        self.span = span
+        self.attempt_spans: dict[str, object] = {}
 
 
 class _Epoch:
@@ -152,9 +204,10 @@ class _Epoch:
 
 class _UpdatePending:
     __slots__ = ("rid", "client_id", "future", "waiting", "acks",
-                 "failures", "t0", "epoch_index", "first_result", "wire")
+                 "failures", "t0", "epoch_index", "first_result", "wire",
+                 "span", "target_spans")
 
-    def __init__(self, rid, client_id, future, waiting, wire):
+    def __init__(self, rid, client_id, future, waiting, wire, span=None):
         self.rid = rid
         self.client_id = client_id
         self.future = future
@@ -165,6 +218,11 @@ class _UpdatePending:
         self.epoch_index: int | None = None
         self.first_result: dict | None = None
         self.wire = wire  # replayable request (catch-up; same request_id)
+        # tracing: root span for the broadcast + one child per target
+        # replica (the wire carries each child's context, so the
+        # worker-side delta application stitches under it)
+        self.span = span
+        self.target_spans: dict[str, object] = {}
 
 
 class Router:
@@ -188,6 +246,8 @@ class Router:
         self._compacted_to = 0
         self._rid_seq = itertools.count(1)
         self._hb_seq = itertools.count(1)
+        self._mx_seq = itertools.count(1)
+        self._tr_seq = itertools.count(1)
         self._update_seq = itertools.count(1)
         self._update_lock = threading.Lock()  # serializes broadcasts
         self._draining = False
@@ -220,6 +280,29 @@ class Router:
             "dpathsim_router_request_seconds",
             "router submit-to-resolve latency by outcome",
         )
+        # -- fleet observability plane (DESIGN.md §24) ------------------
+        # SLO engine over the merged metric stream; alerts surface as
+        # counters/gauges (inside the engine) AND router log events
+        # (the callback — obs cannot emit events itself, layering)
+        specs = tuple(self.config.slo_specs) or default_specs()
+        self.slo = SLOEngine(specs, on_alert=self._on_slo_alert)
+        # tail-sampling flight recorder: slow threshold from config,
+        # else the latency SLO's own p99 target — "slower than the SLO
+        # says p99 may be" IS the tail worth keeping
+        slow_ms = self.config.slow_ms
+        if slow_ms is None:
+            slow_ms = next(
+                (s.threshold * 1e3 for s in specs
+                 if s.kind == "latency" and s.threshold), 1000.0,
+            )
+        self._slow_s = float(slow_ms) / 1e3
+        self.flight = FlightRecorder(self.config.flight_capacity)
+        self._shutdown_dumped = False
+        # optional shutdown artifact paths (set by the CLI): written
+        # during drain, BEFORE workers terminate — a SIGTERM must not
+        # destroy the evidence it should be dumping
+        self.flight_out: str | None = None
+        self.fleet_trace_out: str | None = None
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -280,6 +363,10 @@ class Router:
             time.sleep(0.005)
         else:
             clean = False
+        # shutdown dumps happen HERE — pending flushed, workers still
+        # alive — because the flight/trace artifacts need one last
+        # span-ring scrape, and a terminated worker can't answer it
+        self._shutdown_dumps()
         for w in self.workers.values():
             if w.transport.alive:
                 try:
@@ -317,16 +404,44 @@ class Router:
             return self._submit_update(req, fut)
         if op == "invalidate":
             return self._submit_invalidate(req, fut)
+        if op == "fleet_metrics":
+            resp = {"id": req.get("id"), "ok": True,
+                    "result": self.fleet_metrics(
+                        refresh=bool(req.get("refresh", True))
+                    )}
+            if req.get("request_id") is not None:
+                resp["request_id"] = req["request_id"]
+            fut.set_result(resp)
+            return fut
+        if op == "flight_dump":
+            resp = {"id": req.get("id"), "ok": True,
+                    "result": self.flight_dump(path=req.get("path"))}
+            if req.get("request_id") is not None:
+                resp["request_id"] = req["request_id"]
+            fut.set_result(resp)
+            return fut
         if op not in ROUTED_OPS:
             fut.set_result({"id": req.get("id"), "ok": False,
                             "error": f"unknown op {op!r}"})
             return fut
+        # the fleet-level trace ROOT: head sampling decides here, once,
+        # for the whole fleet — workers inherit the decision on the
+        # wire (a sampled-out root sends {"sampled": false} downstream)
+        root = get_tracer().start_span(
+            "router.request", op=op, row=req.get("row"),
+        )
         with self._lock:
             if len(self._pending) >= self.config.max_inflight:
                 self._m_requests.inc(outcome="shed")
                 runtime_event(
                     "router_shed", depth=self.config.max_inflight,
                     echo=False,
+                )
+                get_tracer().finish(root, outcome="shed")
+                self.flight.keep(
+                    ["shed"],
+                    trace_id=root.trace_id if root else None,
+                    op=op, row=req.get("row"), where="admission",
                 )
                 raise RouterShed(
                     f"router pending table at bound "
@@ -341,7 +456,7 @@ class Router:
             deadline = Deadline.from_ms(
                 req.get("deadline_ms", self.config.default_deadline_ms)
             )
-            p = _Pending(rid, req, key, row, fut, deadline)
+            p = _Pending(rid, req, key, row, fut, deadline, span=root)
             self._pending[rid] = p
         verdict = self._dispatch(p)
         if verdict is not None:
@@ -399,10 +514,14 @@ class Router:
                 return True
         return False
 
-    def _dispatch(self, p: _Pending, exclude: set | None = None) -> str | None:
+    def _dispatch(self, p: _Pending, exclude: set | None = None,
+                  kind: str | None = None) -> str | None:
         """Send ``p`` to the best eligible replica. None on success, an
-        error string when no replica can take it."""
+        error string when no replica can take it. ``kind`` labels the
+        attempt span ("hedge" from the hedge scan; otherwise derived:
+        first try = "primary", re-dispatch = "failover")."""
         exclude = set(exclude or ())
+        tracer = get_tracer()
         while True:
             if p.deadline is not None and p.deadline.expired:
                 return "deadline exceeded"
@@ -415,6 +534,23 @@ class Router:
                 if wid is None:
                     return why
                 w = self.workers[wid]
+                attempt = None
+                if p.span is not None:
+                    # one span per dispatch ATTEMPT, all siblings under
+                    # the request root: a hedged-then-failed-over
+                    # request reads as three parallel subtrees, each
+                    # continuing into its worker's process
+                    attempt = tracer.start_span(
+                        "router.dispatch", parent=p.span.context,
+                        worker=wid,
+                        kind=kind or (
+                            "primary" if not p.tried else "failover"
+                        ),
+                        attempt=len(p.tried),
+                    )
+                    stale = p.attempt_spans.pop(wid, None)
+                    tracer.finish(stale, outcome="superseded")
+                    p.attempt_spans[wid] = attempt
                 p.tried.append(wid)
                 p.assigned.add(wid)
                 w.assigned.add(p.rid)
@@ -423,6 +559,14 @@ class Router:
             wire["request_id"] = p.rid
             if p.deadline is not None:
                 wire["deadline_ms"] = max(p.deadline.remaining_ms(), 0.0)
+            if tracer.enabled:
+                # the worker parents under THIS attempt's span; a
+                # sampled-out request propagates the drop instead, so
+                # the fleet-wide rate stays exactly the configured 1/N
+                wire["trace"] = to_wire(
+                    attempt.context if attempt is not None else None,
+                    sampled=attempt is not None,
+                )
             try:
                 w.transport.send(wire)
                 return None
@@ -430,6 +574,10 @@ class Router:
                 with self._lock:
                     p.assigned.discard(wid)
                     w.assigned.discard(p.rid)
+                    tracer.finish(
+                        p.attempt_spans.pop(wid, None),
+                        outcome="send_failed",
+                    )
                 self._mark_down(wid, DOWN, "send failed")
                 exclude.add(wid)
 
@@ -447,6 +595,46 @@ class Router:
             client_resp["hedged"] = True
         self._m_requests.inc(outcome=outcome)
         self._m_latency.observe(elapsed, outcome=outcome)
+        # seal the trace: outstanding attempt spans (hedge losers, the
+        # straggler a failover abandoned) finish as superseded, then
+        # the root closes with the outcome — one complete tree per
+        # request no matter how many replicas touched it
+        tracer = get_tracer()
+        with self._lock:
+            attempts = list(p.attempt_spans.values())
+            p.attempt_spans.clear()
+        for span in attempts:
+            tracer.finish(span, outcome="superseded")
+        tracer.finish(p.span, outcome=outcome)
+        # tail sampling: the flight recorder keeps EVERY request whose
+        # outcome is worth explaining, independent of the head-sampling
+        # coin flip (obs/flight.py — 100% of errored/shed/hedged/
+        # failed-over/slow/ann-degraded requests, by construction)
+        ann_fb = (resp.get("result") or {}).get("ann_fallback") \
+            if isinstance(resp.get("result"), dict) else None
+        reasons = []
+        if outcome == "error":
+            reasons.append("error")
+        if resp.get("shed"):
+            reasons.append("shed")
+        if p.hedge_sent:
+            reasons.append("hedged")
+        if p.failovers:
+            reasons.append("failover")
+        if ann_fb is not None:
+            reasons.append("ann_fallback")
+        if elapsed > self._slow_s:
+            reasons.append("slow")
+        if reasons:
+            self.flight.keep(
+                reasons,
+                trace_id=p.span.trace_id if p.span is not None else None,
+                rid=p.rid, op=p.req.get("op", "topk"), row=p.row,
+                elapsed_ms=round(elapsed * 1e3, 3),
+                workers=list(p.tried), outcome=outcome,
+                error=resp.get("error"), ann_fallback=ann_fb,
+                failovers=p.failovers,
+            )
         p.future.set_result(client_resp)
 
     def _park_or_fail(self, p: _Pending, verdict: str) -> None:
@@ -526,6 +714,12 @@ class Router:
         if isinstance(rid, str) and rid.startswith("hb:"):
             self._on_pong(wid, obj)
             return
+        if isinstance(rid, str) and rid.startswith("mx:"):
+            self._on_metrics(wid, obj)
+            return
+        if isinstance(rid, str) and rid.startswith("tr:"):
+            self._on_trace(wid, obj)
+            return
         if isinstance(rid, str) and rid.startswith(("up:", "cu:")):
             self._on_update_ack(wid, rid, obj)
             return
@@ -538,6 +732,11 @@ class Router:
                 del self._pending[rid]
                 for awid in p.assigned:
                     self.workers[awid].assigned.discard(rid)
+                # the winning attempt closes with the answer; the
+                # losers are sealed as superseded inside _resolve
+                get_tracer().finish(
+                    p.attempt_spans.pop(wid, None), outcome="ok"
+                )
         if p is None:
             # hedge loser, or a stall-suspected worker answering after
             # its work was already failed over — dedup: drop + count
@@ -561,6 +760,9 @@ class Router:
         with self._lock:
             p.assigned.discard(wid)
             self.workers[wid].assigned.discard(p.rid)
+            get_tracer().finish(
+                p.attempt_spans.pop(wid, None), outcome="worker_error"
+            )
             if p.assigned:
                 return  # a hedge is still in flight; let it race
         p.failovers += 1
@@ -588,6 +790,9 @@ class Router:
             w.assigned.clear()
             for p in orphans:
                 p.assigned.discard(wid)
+                get_tracer().finish(
+                    p.attempt_spans.pop(wid, None), outcome="worker_down"
+                )
         runtime_event(
             "router_worker_down", worker_id=wid, status=status,
             reason=reason, orphaned=len(orphans),
@@ -615,11 +820,23 @@ class Router:
         tick = min(interval, (hedge_s / 4) if hedge_s else interval)
         tick = max(tick, 0.005)
         next_probe = 0.0
+        next_scrape = 0.0
         while not self._closed.wait(tick):
             now = time.monotonic()
             if now >= next_probe:
                 next_probe = now + interval
                 self._probe_workers(now)
+            if cfg.scrape_interval_s and now >= next_scrape:
+                next_scrape = now + cfg.scrape_interval_s
+                # merge + SLO first, over the PREVIOUS round's replies
+                # (a scrape is async — evaluating right after sending
+                # would always read stale-by-one snapshots anyway, and
+                # this way one tick is one coherent evaluate-then-ask)
+                try:
+                    self._evaluate_slo(now)
+                except Exception as exc:
+                    runtime_event("fleet_slo_error", error=repr(exc))
+                self._scrape_workers()
             if hedge_s is not None:
                 self._hedge_scan(now, hedge_s)
             self._retry_parked(now)
@@ -733,7 +950,9 @@ class Router:
             # original is still in flight; only a hedge that actually
             # went out is counted and flagged (a 1-replica router must
             # not fabricate hedge accounting)
-            if self._dispatch(p, exclude=set(p.tried)) is None and (
+            if self._dispatch(
+                p, exclude=set(p.tried), kind="hedge"
+            ) is None and (
                 len(p.assigned) > 1
             ):
                 p.hedge_sent = True
@@ -746,6 +965,7 @@ class Router:
     # -- delta broadcast & fencing -----------------------------------------
 
     def _submit_update(self, req: dict, fut: Future) -> Future:
+        tracer = get_tracer()
         with self._update_lock:
             seq = next(self._update_seq)
             urid = f"u{seq}"
@@ -753,23 +973,39 @@ class Router:
             wire["request_id"] = urid
             wire["want_rows"] = True
             wire.pop("id", None)  # per-worker ids are stamped per send
+            root = tracer.start_span("router.update", rid=urid)
             with self._lock:
                 targets = [
                     w for w in self.workers.values()
                     if w.status == UP and w.transport.alive
                 ]
                 if not targets:
+                    tracer.finish(root, outcome="no_workers")
                     fut.set_result({"id": req.get("id"), "ok": False,
                                     "error": "no live workers"})
                     return fut
                 up = _UpdatePending(
                     urid, req.get("id"), fut, [w.wid for w in targets],
-                    wire,
+                    wire, span=root,
                 )
                 self._updates[urid] = up
             for w in targets:
                 per_wire = dict(wire)
                 per_wire["id"] = f"up:{w.wid}:{seq}"
+                if root is not None:
+                    # one broadcast span per replica, the wire carrying
+                    # its context: every replica's delta application
+                    # stitches under the ONE router.update tree (and a
+                    # background ann refresh it schedules links back
+                    # to its serve.op span — obs/trace.py)
+                    bspan = tracer.start_span(
+                        "router.broadcast", parent=root.context,
+                        worker=w.wid,
+                    )
+                    up.target_spans[w.wid] = bspan
+                    per_wire["trace"] = to_wire(bspan.context)
+                elif tracer.enabled:
+                    per_wire["trace"] = to_wire(None, sampled=False)
                 try:
                     # the delta_broadcast seam: an injected error means
                     # THIS worker misses the update — it will lag the
@@ -844,6 +1080,9 @@ class Router:
             if up is not None:
                 up.waiting.discard(wid)
                 up.acks[wid] = result
+                get_tracer().finish(
+                    up.target_spans.pop(wid, None), outcome="ack"
+                )
                 # a replica that missed the broadcast but caught up
                 # before the update finished has APPLIED it — it must
                 # not be reported as both applied and lagging
@@ -864,6 +1103,10 @@ class Router:
                 return
             up.waiting.discard(wid)
             up.failures[wid] = error
+            get_tracer().finish(
+                up.target_spans.pop(wid, None), outcome="missed",
+                error=error,
+            )
             if not up.waiting:
                 finished = self._updates.pop(urid)
         runtime_event(
@@ -874,6 +1117,11 @@ class Router:
 
     def _finish_update(self, up: _UpdatePending) -> None:
         ok = up.epoch_index is not None
+        tracer = get_tracer()
+        for span in up.target_spans.values():
+            tracer.finish(span, outcome="timeout")
+        up.target_spans.clear()
+        tracer.finish(up.span, outcome="ok" if ok else "failed")
         result = {
             "applied": sorted(up.acks),
             "missed": dict(up.failures),
@@ -960,6 +1208,208 @@ class Router:
         })
         return fut
 
+    # -- fleet observability plane (DESIGN.md §24) -------------------------
+
+    def _scrape_workers(self) -> None:
+        """Ask every live worker for its registry snapshot (the
+        ``metrics`` op); replies land in :meth:`_on_metrics`. Send
+        failures are the heartbeat path's business — here they are
+        simply skipped (the merge uses whatever snapshots exist)."""
+        for w in list(self.workers.values()):
+            if w.status == DOWN or not w.transport.alive:
+                continue
+            try:
+                w.transport.send(
+                    {"id": f"mx:{w.wid}:{next(self._mx_seq)}",
+                     "op": "metrics"}
+                )
+            except WorkerGone:
+                continue
+
+    def _on_metrics(self, wid: str, obj: dict) -> None:
+        if not obj.get("ok"):
+            return
+        result = obj.get("result") or {}
+        registry = result.get("registry")
+        if not isinstance(registry, dict):
+            return
+        with self._lock:
+            w = self.workers.get(wid)
+            if w is None:
+                return
+            w.last_metrics = registry
+            w.metrics_seq += 1
+            w.metrics_mono = time.monotonic()
+
+    def _on_trace(self, wid: str, obj: dict) -> None:
+        if not obj.get("ok"):
+            return
+        result = obj.get("result") or {}
+        if "spans" not in result:
+            return
+        with self._lock:
+            w = self.workers.get(wid)
+            if w is None:
+                return
+            w.trace_part = {**result, "process": f"worker {wid}"}
+            w.trace_seq += 1
+
+    def metric_parts(self) -> dict:
+        """The merge inputs: the router's own registry plus every
+        worker's last scraped snapshot, keyed by identity. A worker
+        never scraped (or dead before its first scrape) simply isn't a
+        part — the merge is exact over what exists."""
+        parts = {"router": get_registry().snapshot()}
+        with self._lock:
+            for wid, w in self.workers.items():
+                if w.last_metrics is not None:
+                    parts[wid] = w.last_metrics
+        return parts
+
+    def _evaluate_slo(self, now: float) -> None:
+        merged, _ = obs_fleet.merge_registry_snapshots(self.metric_parts())
+        self.slo.observe(merged, now)
+
+    def _on_slo_alert(self, info: dict) -> None:
+        # the router LOG surface the ISSUE asks for: burn-rate alerts
+        # as structured events alongside the engine's counters/gauges
+        runtime_event(
+            "slo_alert", slo=info["slo"], kind=info["kind"],
+            objective=info["objective"],
+            burn={k: round(v, 3) for k, v in info["burn"].items()},
+        )
+
+    def _wait_scraped(self, seq0: dict, attr: str, timeout: float) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                done = all(
+                    w.status == DOWN or not w.transport.alive
+                    or getattr(w, attr) > seq0.get(wid, 0)
+                    for wid, w in self.workers.items()
+                )
+            if done:
+                return
+            time.sleep(0.005)
+
+    def fleet_metrics(self, refresh: bool = True,
+                      timeout: float = 5.0) -> dict:
+        """The ``fleet_metrics`` op payload: merged (bucket-exact)
+        registries with per-worker snapshots' provenance, SLO status,
+        and the router's own stats block. ``refresh`` forces a fresh
+        scrape round and waits for it — a one-shot ``dpathsim
+        fleet-stats`` must not read a snapshot that predates the
+        question."""
+        if refresh:
+            with self._lock:
+                seq0 = {w.wid: w.metrics_seq
+                        for w in self.workers.values()}
+            self._scrape_workers()
+            self._wait_scraped(seq0, "metrics_seq", timeout)
+        parts = self.metric_parts()
+        merged, unmergeable = obs_fleet.merge_registry_snapshots(parts)
+        now = time.monotonic()
+        with self._lock:
+            scrape_age = {
+                wid: (
+                    round(now - w.metrics_mono, 3)
+                    if w.last_metrics is not None else None
+                )
+                for wid, w in self.workers.items()
+            }
+        return {
+            "router": self.stats()["router"],
+            "merged": merged,
+            "unmergeable": unmergeable,
+            "scrape_age_s": scrape_age,
+            "workers_scraped": sorted(k for k in parts if k != "router"),
+            "slo": self.slo.snapshot(),
+            "flight": {
+                "kept_total": self.flight.kept_total,
+                "dropped": self.flight.dropped,
+                "capacity": self.flight.capacity,
+            },
+        }
+
+    def collect_trace_parts(self, timeout: float = 5.0) -> list[dict]:
+        """The stitched-export inputs: this process's span ring plus a
+        fresh ``trace``-op scrape of every live worker's. Dead workers
+        contribute whatever their last scrape caught (a SIGKILL takes
+        its un-scraped spans with it — the router-side attempt spans
+        still record that the dispatch happened)."""
+        with self._lock:
+            seq0 = {w.wid: w.trace_seq for w in self.workers.values()}
+        limit = self.config.trace_scrape_limit
+        for w in list(self.workers.values()):
+            if w.status == DOWN or not w.transport.alive:
+                continue
+            try:
+                w.transport.send(
+                    {"id": f"tr:{w.wid}:{next(self._tr_seq)}",
+                     "op": "trace", "limit": limit}
+                )
+            except WorkerGone:
+                continue
+        self._wait_scraped(seq0, "trace_seq", timeout)
+        parts = [{**get_tracer().export_state(limit=limit),
+                  "process": "router"}]
+        with self._lock:
+            for w in self.workers.values():
+                if w.trace_part is not None:
+                    parts.append(w.trace_part)
+        return parts
+
+    def write_fleet_trace(self, path: str,
+                          parts: list[dict] | None = None) -> int:
+        """One stitched Perfetto file for the whole fleet; returns the
+        span-event count. ``parts`` reuses an already-collected scrape
+        (the shutdown path shares one round across both dumps)."""
+        if parts is None:
+            parts = self.collect_trace_parts()
+        n = obs_fleet.write_fleet_trace(path, parts)
+        runtime_event("fleet_trace_written", path=path, spans=n)
+        return n
+
+    def flight_dump(self, path: str | None = None,
+                    parts: list[dict] | None = None) -> dict:
+        """The ``flight_dump`` op: records + kept span trees, written
+        atomically when ``path`` is given, inline (records only — span
+        trees can be arbitrarily large) otherwise."""
+        if path is None:
+            return self.flight.snapshot()
+        if parts is None:
+            parts = (
+                self.collect_trace_parts()
+                if get_tracer().enabled else []
+            )
+        info = self.flight.dump(path, parts)
+        runtime_event("flight_dump", **info)
+        return info
+
+    def _shutdown_dumps(self) -> None:
+        """Drain-time artifacts (flight recording, stitched trace) —
+        once, best-effort: a failing dump must not block the drain.
+        ONE span-ring scrape feeds both dumps; each worker's ring is a
+        trace-op round trip of up to 20k spans, not something to ask
+        for twice at shutdown."""
+        if self._shutdown_dumped:
+            return
+        self._shutdown_dumped = True
+        try:
+            parts = None
+            if (self.flight_out or self.fleet_trace_out) and (
+                get_tracer().enabled
+            ):
+                parts = self.collect_trace_parts()
+            if self.flight_out:
+                self.flight_dump(self.flight_out, parts=parts or [])
+            if self.fleet_trace_out:
+                self.write_fleet_trace(
+                    self.fleet_trace_out, parts=parts or []
+                )
+        except Exception as exc:
+            runtime_event("fleet_dump_failed", error=repr(exc))
+
     # -- introspection -----------------------------------------------------
 
     def worker_health(self, wid: str, timeout: float = 10.0) -> dict:
@@ -1013,5 +1463,12 @@ class Router:
                     "routing": self.config.routing,
                     "draining": self._draining,
                     "n": self.n,
+                    "obs": {
+                        "slo_alerts": dict(self.slo.alert_counts),
+                        "flight_kept": self.flight.kept_total,
+                        "flight_dropped": self.flight.dropped,
+                        "scrape_interval_s":
+                            self.config.scrape_interval_s,
+                    },
                 },
             }

@@ -29,6 +29,7 @@ Robustness contracts implemented here:
 
 from __future__ import annotations
 
+import contextlib
 import json
 import threading
 import time
@@ -37,6 +38,7 @@ from typing import IO, Callable
 
 import numpy as np
 
+from ..obs.trace import from_wire, get_tracer
 from ..resilience import (
     Deadline,
     inject,
@@ -53,6 +55,8 @@ from ..utils.logging import runtime_event
 MUTATING_OPS = frozenset({"update", "invalidate"})
 
 _DEDUP_CAPACITY = 1024
+
+_NULL_CTX = contextlib.nullcontext()
 
 
 class WorkerRuntime:
@@ -149,12 +153,31 @@ class WorkerRuntime:
 
     def _handle_topk(self, req: dict, reply: Callable[[dict], None]) -> None:
         """The async hot path: resolve + submit on the read thread,
-        answer from the future's completion."""
+        answer from the future's completion.
+
+        Trace stitching: a ``trace`` context on the wire parents this
+        worker's spans under the router's dispatch span. The
+        ``worker.request`` span covers the full async lifecycle —
+        opened here on the read thread, finished when the future
+        resolves on the completer thread — and the service's
+        ``serve.request`` tree hangs under it (the remote context is
+        activated around the submit). A ``sampled: false`` context
+        creates nothing anywhere downstream."""
         rid = req.get("id")
         request_id = req.get("request_id")
         deadline = Deadline.from_ms(req.get("deadline_ms"))
+        tracer = get_tracer()
+        rctx = from_wire(req.get("trace"))
+        wspan = (
+            tracer.start_span(
+                "worker.request", parent=rctx,
+                worker=self.worker_id, op="topk",
+            )
+            if rctx is not None else None
+        )
 
         def fail(error: str, **flags) -> None:
+            tracer.finish(wspan, outcome="error", error=error)
             resp = {"id": rid, "ok": False, "error": error, **flags}
             if request_id is not None:
                 resp["request_id"] = request_id
@@ -193,16 +216,30 @@ class WorkerRuntime:
         policy = policy_from_env(max_attempts=2)
         if deadline is not None:
             policy = deadline.clamp(policy)
+        # fallback annotation for the router's tail sampler: a
+        # side-effect-free peek (the answering path counts it), read
+        # BEFORE the submit so the response can say "this ann request
+        # will answer exactly, and why" — what lets the fleet flight
+        # recorder keep 100% of ann-degraded requests
+        try:
+            ann_fallback = self.service.ann_fallback_reason(row, mode)
+        except Exception:
+            ann_fallback = None
+        # the remote trace context (or this worker's request span)
+        # becomes the submit's ambient parent: the coalescer pipeline's
+        # spans land inside the fleet trace
+        ctx = wspan.context if wspan is not None else rctx
         try:
             # mode rides through: a replica WITHOUT an index answers an
             # "ann" request exactly (counted as a no_index fallback) —
             # which is what makes re-dispatching an ann query onto any
             # surviving replica always safe
-            future = resilient_call(
-                "worker_dispatch",
-                lambda: self.service.submit_topk(row, k, mode=mode),
-                policy,
-            )
+            with tracer.activate(ctx) if ctx is not None else _NULL_CTX:
+                future = resilient_call(
+                    "worker_dispatch",
+                    lambda: self.service.submit_topk(row, k, mode=mode),
+                    policy,
+                )
         except LoadShedError:
             fail("shed", shed=True)
             return
@@ -230,16 +267,20 @@ class WorkerRuntime:
                     hits.append(
                         {"id": i_id, "label": lab, "score": float(v)}
                     )
+                result = {"row": int(row), "topk": hits}
+                if ann_fallback is not None:
+                    result["ann_fallback"] = ann_fallback
                 resp = {
                     "id": rid,
                     "ok": True,
-                    "result": {"row": int(row), "topk": hits},
+                    "result": result,
                     "latency_ms": round(
                         (time.perf_counter() - t0) * 1e3, 3
                     ),
                 }
                 if request_id is not None:
                     resp["request_id"] = request_id
+                tracer.finish(wspan, outcome="ok")
                 reply(resp)
             finally:
                 self._untrack(token)

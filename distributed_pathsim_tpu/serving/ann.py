@@ -127,16 +127,25 @@ class AnnState:
 
     # -- eligibility -------------------------------------------------------
 
+    def peek(self, row: int) -> str | None:
+        """Eligibility WITHOUT the counter side effect: the fallback
+        reason that would apply, or None. Observers (the worker's
+        response annotation, the flight recorder's classification)
+        read this; only the answering path (:meth:`eligible`) counts —
+        otherwise one degraded request would tick the fallback counter
+        once per onlooker."""
+        if not self.enabled:
+            return "low_confidence"
+        if not self.index.covers(row):
+            return "stale" if 0 <= row < self.index.n else "uncovered"
+        if not (0 <= row < self.d.shape[0]) or self.d[row] <= 0:
+            return "degenerate"
+        return None
+
     def eligible(self, row: int) -> str | None:
         """None when the ANN path may answer ``row``; otherwise the
         fallback reason (also counted)."""
-        reason = None
-        if not self.enabled:
-            reason = "low_confidence"
-        elif not self.index.covers(row):
-            reason = "stale" if 0 <= row < self.index.n else "uncovered"
-        elif not (0 <= row < self.d.shape[0]) or self.d[row] <= 0:
-            reason = "degenerate"
+        reason = self.peek(row)
         if reason is not None:
             self.note_fallback(reason)
         return reason

@@ -155,10 +155,16 @@ class Coalescer:
             "requests per dispatched batch, by shape bucket",
             bounds=tuple(float(1 << i) for i in range(11)),
         )
-        self._m_wait = reg.histogram(
+        # labeled per LANE, cells bound lazily on first dispatch of a
+        # lane: an ann probe batch and an exact batch have different
+        # wait-time economics (the probe's matmul is tiny, so queue
+        # time dominates it sooner), and a fleet-level SLO over batch
+        # wait must be able to tell them apart
+        self._m_wait_family = reg.histogram(
             "dpathsim_serve_batch_wait_seconds",
-            "first-enqueue to dispatch wait per batch",
-        ).labels()
+            "first-enqueue to dispatch wait per batch, by lane",
+        )
+        self._m_wait_cells: dict[str, Any] = {}
         self._lock = threading.Lock()
         self._not_empty = threading.Condition(self._lock)
         self._queue: collections.deque[Request] = collections.deque()
@@ -340,7 +346,13 @@ class Coalescer:
                 else:
                     tracer.finish(r.enq_span)
             self._m_occupancy.observe(len(batch), bucket=bucket)
-            self._m_wait.observe(wait_ms / 1e3)
+            lane = batch[0].lane
+            wait_cell = self._m_wait_cells.get(lane)
+            if wait_cell is None:
+                wait_cell = self._m_wait_cells[lane] = (
+                    self._m_wait_family.labels(lane=lane)
+                )
+            wait_cell.observe(wait_ms / 1e3)
             try:
                 dev = (
                     tracer.start_span(

@@ -47,6 +47,18 @@ clients keep working unchanged:
   retries (:class:`~..resilience.Deadline` threaded into
   ``RetryPolicy``) are clamped so they can never overshoot it.
 
+A third defaulted field, ``trace``, carries distributed trace context
+(DESIGN.md §24): ``{"trace_id": ..., "span_id": ...}`` re-roots this
+request's spans under the sender's span (the router stamps its
+per-attempt dispatch span, so hedges and failovers become sibling
+subtrees of one fleet trace), and ``{"sampled": false}`` propagates a
+dropped-head sampling decision — the receiver creates zero spans for
+the request, keeping the configured 1/N head rate fleet-wide. Absent,
+tracing behaves exactly as before. The ``trace`` *op* is the matching
+scrape endpoint: it returns this process's span ring (+ pid + wall
+anchor) for the router's stitched Perfetto export and flight-recorder
+dumps.
+
 The ``health`` op is the heartbeat/probe endpoint: O(1) liveness plus
 the load signals a router routes on (queue depth, in-flight count) and
 the replica-consistency token ``(base_fp, delta_seq)`` that fences a
@@ -80,17 +92,29 @@ not take the service down for everyone else.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import time
 from typing import IO
 
 from ..obs.metrics import get_registry
-from ..obs.trace import get_tracer
+from ..obs.trace import from_wire, get_tracer
 from ..resilience import Deadline, DeadlineExceeded
 from ..utils.logging import runtime_event
 from .service import PathSimService
 
 _QUERY_KEYS = ("source", "source_id", "row")
+
+# The op vocabulary, registered in one place: scripts/lint_telemetry.py
+# statically checks that every op string _dispatch_op handles appears
+# here, and tests/test_fleet_obs.py drives every registered op through
+# handle_request asserting the request_id echo — so a new op cannot
+# land without the idempotency/dedup machinery (router retries, hedges)
+# being able to correlate its responses.
+PROTOCOL_OPS = frozenset({
+    "ping", "stats", "metrics", "health", "invalidate", "topk",
+    "refresh_index", "update", "scores", "trace",
+})
 
 # op → (latency-histogram cell, error-counter cell), bound on first use
 # so the steady-state path pays cell increments, never registry/label
@@ -198,6 +222,15 @@ def _dispatch_op(
         }
     if op == "refresh_index":
         return service.refresh_index()
+    if op == "trace":
+        # the span-ring scrape: the router's fleet-trace export and
+        # flight-recorder dumps collect each worker's ring through
+        # this op and stitch them (obs/fleet.py). Bounded payload —
+        # the ring can hold 200k spans and the wire is one JSON line.
+        limit = req.get("limit")
+        return get_tracer().export_state(
+            limit=int(limit) if limit else 20_000
+        )
     if op == "update":
         from ..data.delta import delta_from_records
 
@@ -236,9 +269,20 @@ def handle_request(service: PathSimService, req: dict) -> dict:
             )
         # protocol-level span: the outermost segment of a served
         # request's trace (the serve.request root parents under it on
-        # query ops)
-        with get_tracer().span("serve.op", op=op):
-            result = _dispatch_op(service, op, req, deadline=deadline)
+        # query ops). A ``trace`` field on the wire re-roots it under
+        # the SENDING process's span — the router's dispatch span —
+        # so the fleet export stitches one cross-process tree; a
+        # ``sampled: false`` context suppresses every span downstream
+        # (the head decision travels with the request).
+        rctx = from_wire(req.get("trace"))
+        tracer = get_tracer()
+        activation = (
+            tracer.activate(rctx) if rctx is not None
+            else contextlib.nullcontext()
+        )
+        with activation:
+            with tracer.span("serve.op", op=op):
+                result = _dispatch_op(service, op, req, deadline=deadline)
     except Exception as exc:  # per-request failure, not process failure
         latency_cell.observe(time.perf_counter() - t0)
         error_cell.inc()

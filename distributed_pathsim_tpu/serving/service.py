@@ -535,6 +535,21 @@ class PathSimService:
             )
         return mode
 
+    def ann_fallback_reason(self, row: int,
+                            mode: str | None = None) -> str | None:
+        """Would an (effective-)``ann`` query for ``row`` degrade to
+        the exact path right now, and why? A side-effect-free peek —
+        no fallback counters tick — for observers: the worker annotates
+        responses with it so the router's flight recorder can
+        tail-keep ann-degraded requests. None = the ANN path answers
+        (or the effective mode is exact, where "fallback" is
+        meaningless)."""
+        if self._resolve_mode(mode) != "ann":
+            return None
+        if self._ann is None:
+            return "no_index"
+        return self._ann.peek(int(row))
+
     def _ann_key(self, row: int, k: int) -> tuple:
         """ANN result-cache key: the exact path's epoch prefix (base
         fp + per-row delta version — a delta on this row invalidates
@@ -870,22 +885,37 @@ class PathSimService:
                     # background re-embed: stale rows answer exactly in
                     # the meantime, so serving correctness never waits
                     # on this thread (it blocks on the swap lock we
-                    # still hold, then runs)
+                    # still hold, then runs). The spawning update's
+                    # span context rides along as a LINK: the refresh
+                    # runs as its own trace (it outlives the update's
+                    # request), but its root span names the update
+                    # span that caused it, so the fleet export can
+                    # join cause to effect (DESIGN.md §24).
+                    cur = get_tracer().current()
+                    link = (
+                        f"{cur.trace_id}:{cur.span_id}"
+                        if cur is not None and cur.span_id else None
+                    )
                     self._ann_refresh_inflight = True
                     threading.Thread(
                         target=self._refresh_index_quietly,
+                        args=(link,),
                         name="pathsim-ann-refresh", daemon=True,
                     ).start()
             return result
 
-    def _refresh_index_quietly(self) -> None:
+    def _refresh_index_quietly(self, link: str | None = None) -> None:
         try:
-            # an abandoned attempt (a newer delta landed mid-fold)
-            # retries against the newer token — deltas that arrived
-            # while we were the debounced in-flight refresh must not
-            # be left stale until some future update happens by
-            while self.refresh_index().get("abandoned"):
-                pass
+            # its own root span (head sampling applies — a refresh is a
+            # background job, not a request), LINKED to the update that
+            # scheduled it via the ``link`` arg ("trace:span")
+            with get_tracer().span("ann.refresh", link=link):
+                # an abandoned attempt (a newer delta landed mid-fold)
+                # retries against the newer token — deltas that arrived
+                # while we were the debounced in-flight refresh must
+                # not be left stale until some future update happens by
+                while self.refresh_index().get("abandoned"):
+                    pass
         except Exception as exc:  # background thread: report, never die
             runtime_event("ann_refresh_failed", error=repr(exc))
         finally:
@@ -933,9 +963,13 @@ class PathSimService:
             token0 = self.consistency_token
             hin = self.hin
             stale_rows = np.flatnonzero(ann.index.stale)
-        c, d = half_chain_and_denominators(
-            hin, self.metapath, self.variant
-        )
+        tracer = get_tracer()
+        with tracer.child_span(
+            "index.half_chain_fold", stale=int(stale_rows.size)
+        ):
+            c, d = half_chain_and_denominators(
+                hin, self.metapath, self.variant
+            )
         emb = (
             refresh_embeddings(ann.index, stale_rows, c, d)
             if stale_rows.size else None

@@ -23,6 +23,19 @@ R3  ``_EVENT_SINK`` outside ``utils/logging.py``. Writing to the sink
     directly skips the lock, the obs event counter, and the stderr
     echo policy — the exact bypass the sink's lock exists to prevent.
 
+R6/R7 (ISSUE 9) extend the raw-print discipline to the ``index/`` and
+``obs/`` subsystems: index background refreshes run inside serving
+workers whose stdout IS the JSONL wire, and the obs package is the
+reporting layer itself — a print inside either is invisible to the
+sink and can corrupt a worker's protocol stream. ``index/cli.py``'s
+user-facing JSON output is the one sanctioned site.
+
+R8 is structural: every op string ``serving/protocol._dispatch_op``
+handles must be registered in ``PROTOCOL_OPS`` — the registry the
+request_id-echo test (tests/test_fleet_obs.py) iterates — so a new
+protocol op cannot land without proving the router's retry/hedge/dedup
+machinery can correlate its responses.
+
 Runs as ``make lint-telemetry`` and as a non-slow pytest
 (tests/test_obs.py::test_lint_telemetry), so tier-1 catches a new
 violation the moment it lands.
@@ -107,7 +120,75 @@ RULES = (
         allowed_files=frozenset({"router/cli.py"}),
         only_under="router/",
     ),
+    Rule(
+        name="index-raw-print",
+        pattern=re.compile(r"(?<![\w.])print\("),
+        why=(
+            "index/ code runs inside serving workers whose stdout IS "
+            "the JSONL wire (background refresh threads, in-process "
+            "builds) — report through runtime_event(); index/cli.py's "
+            "user-facing JSON output is the one sanctioned site"
+        ),
+        allowed_files=frozenset({"index/cli.py"}),
+        only_under="index/",
+    ),
+    Rule(
+        name="obs-raw-print",
+        pattern=re.compile(r"(?<![\w.])print\("),
+        why=(
+            "obs/ IS the reporting layer — a print inside it bypasses "
+            "the very sink/counter discipline it exists to provide "
+            "(and obs code runs inside workers whose stdout is the "
+            "wire); return strings for the CLI surface to print"
+        ),
+        allowed_files=frozenset(),
+        only_under="obs/",
+    ),
 )
+
+# -- R8: protocol-op registry (structural, not a line regex) ----------------
+#
+# serving/protocol.py must register every op its dispatch table handles
+# in PROTOCOL_OPS: the registry is what the request_id-echo test
+# (tests/test_fleet_obs.py::test_protocol_ops_echo_request_id) iterates,
+# so an unregistered op is an op whose responses the router's
+# retry/hedge/dedup machinery was never proven able to correlate.
+
+_OP_COMPARE = re.compile(r"\bop\s*==\s*\"([a-z_]+)\"")
+_REGISTRY = re.compile(
+    r"PROTOCOL_OPS\s*=\s*frozenset\(\{(.*?)\}\)", re.DOTALL
+)
+
+
+def check_protocol_registry() -> list[Violation]:
+    path = PACKAGE / "serving" / "protocol.py"
+    try:
+        text = path.read_text(encoding="utf-8")
+    except OSError:
+        return []
+    m = _REGISTRY.search(text)
+    registered = set(re.findall(r"\"([a-z_]+)\"", m.group(1))) if m else set()
+    out: list[Violation] = []
+    if not m:
+        out.append(Violation(
+            rule="protocol-op-registry",
+            path="distributed_pathsim_tpu/serving/protocol.py", line=1,
+            text="PROTOCOL_OPS registry missing",
+            why="protocol.py must declare PROTOCOL_OPS (the op registry "
+            "the request_id-echo test iterates)",
+        ))
+    for i, line in enumerate(text.splitlines(), 1):
+        for op in _OP_COMPARE.findall(line):
+            if op not in registered:
+                out.append(Violation(
+                    rule="protocol-op-registry",
+                    path="distributed_pathsim_tpu/serving/protocol.py",
+                    line=i, text=line,
+                    why=f"op {op!r} handled but not registered in "
+                    "PROTOCOL_OPS — register it so the request_id-echo "
+                    "test covers it",
+                ))
+    return out
 
 # print(...) spanning lines would dodge a per-line regex; scan whole
 # files with a multiline-tolerant pass instead of per-line matching.
@@ -158,6 +239,7 @@ def scan_package() -> list[Violation]:
     for path in sorted(PACKAGE.rglob("*.py")):
         rel = path.relative_to(PACKAGE).as_posix()
         violations.extend(scan_file(path, rel))
+    violations.extend(check_protocol_registry())
     return violations
 
 

@@ -1,0 +1,771 @@
+"""Fleet observability (ISSUE 9 / DESIGN.md §24).
+
+The load-bearing guarantees:
+
+- merging K randomly-split registries is BIT-IDENTICAL to observing
+  the union in one registry (count/sum/min/max and every bucket), and
+  the merge is associative + commutative — so scrape order can never
+  change a fleet number;
+- the quantile-error bound survives the merge (same estimator, exactly
+  merged buckets);
+- trace context rides the protocol: a remote parent stitches worker
+  spans into the router's trace, a ``sampled: false`` context creates
+  zero spans downstream, and hedge/failover re-dispatches are sibling
+  attempt spans under one root;
+- the SLO engine's multi-window burn-rate math fires only when every
+  window burns, over windowed deltas of cumulative counts;
+- the flight recorder retains 100% of errored/shed/hedged/failed-over
+  requests while head sampling stays at its configured rate;
+- every registered protocol op echoes ``request_id`` (the registry the
+  telemetry lint enforces);
+- the router CLI forwards per-worker-suffixed artifact paths.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributed_pathsim_tpu import obs
+from distributed_pathsim_tpu.backends.base import create_backend
+from distributed_pathsim_tpu.data.synthetic import synthetic_hin
+from distributed_pathsim_tpu.obs import fleet as obs_fleet
+from distributed_pathsim_tpu.obs import slo as obs_slo
+from distributed_pathsim_tpu.obs.flight import FlightRecorder
+from distributed_pathsim_tpu.obs.metrics import MetricsRegistry
+from distributed_pathsim_tpu.obs.trace import Tracer, from_wire, to_wire
+from distributed_pathsim_tpu.ops.metapath import compile_metapath
+from distributed_pathsim_tpu.router import (
+    InprocTransport,
+    Router,
+    RouterConfig,
+    WorkerRuntime,
+)
+from distributed_pathsim_tpu.router.cli import _suffix_path, _worker_argv
+from distributed_pathsim_tpu.serving import PathSimService, ServeConfig
+from distributed_pathsim_tpu.serving.protocol import (
+    PROTOCOL_OPS,
+    handle_request,
+)
+
+# -- exact histogram merge -------------------------------------------------
+
+
+def _dyadic_samples(rng, n):
+    """Samples whose float sums are EXACT in any order (dyadic
+    rationals well inside the mantissa): addition is associative on
+    them, so the bit-identity property covers ``sum`` too — with
+    arbitrary floats only counts/min/max/buckets are exact while sums
+    agree to rounding, which is the weaker guarantee the docs state."""
+    return [
+        int(rng.integers(1, 1 << 20)) * 2.0 ** -18 for _ in range(n)
+    ]
+
+
+def test_merge_bit_identical_to_single_registry_property():
+    rng = np.random.default_rng(7)
+    for trial in range(5):
+        k = int(rng.integers(2, 6))
+        samples = _dyadic_samples(rng, 400)
+        shards = [MetricsRegistry() for _ in range(k)]
+        oracle = MetricsRegistry()
+        for i, v in enumerate(samples):
+            shards[i % k].histogram("h", "x").observe(v, op="topk")
+            oracle.histogram("h", "x").observe(v, op="topk")
+            shards[i % k].counter("c", "x").inc(op="topk")
+            oracle.counter("c", "x").inc(op="topk")
+        parts = {f"w{i}": s.snapshot() for i, s in enumerate(shards)}
+        merged, unmergeable = obs_fleet.merge_registry_snapshots(parts)
+        assert unmergeable == []
+        want = oracle.snapshot()["h"]["values"][0]
+        got = merged["h"]["values"][0]
+        for key in ("count", "sum", "min", "max", "underflow",
+                    "overflow", "_counts", "p50", "p95", "p99"):
+            assert got[key] == want[key], (trial, key)
+        assert merged["c"]["values"][0]["value"] == 400
+
+
+def test_merge_associative_and_commutative():
+    rng = np.random.default_rng(3)
+    cells = []
+    bounds = None
+    for _ in range(3):
+        reg = MetricsRegistry()
+        for v in _dyadic_samples(rng, 100):
+            reg.histogram("h", "x").observe(v)
+        snap = reg.snapshot()["h"]
+        bounds = snap["bounds"]
+        cells.append(snap["values"][0])
+    a, b, c = cells
+    m = obs_fleet.merge_histogram_cells
+    ab_c = m([m([a, b], bounds), c], bounds)
+    a_bc = m([a, m([b, c], bounds)], bounds)
+    abc = m([a, b, c], bounds)
+    ba = m([b, a], bounds)
+    ab = m([a, b], bounds)
+    for key in ("count", "sum", "min", "max", "_counts", "p99"):
+        assert ab_c[key] == a_bc[key] == abc[key]
+        assert ab[key] == ba[key]
+
+
+def test_merge_quantile_error_bound_preserved():
+    """The PR-4 bound — relative error ≤ 10^(1/16) − 1 within the
+    bucketed range — must hold for quantiles computed from MERGED
+    buckets, judged against numpy on the union of the raw samples."""
+    rng = np.random.default_rng(11)
+    shards = [MetricsRegistry() for _ in range(4)]
+    # heavy-tail mixture spanning several decades, inside [lo, hi]
+    samples = np.concatenate([
+        rng.lognormal(-7, 1.0, size=600),
+        rng.lognormal(-2, 0.5, size=60),
+    ])
+    samples = np.clip(samples, 2e-6, 50.0)
+    for i, v in enumerate(samples):
+        shards[i % 4].histogram("h", "x").observe(float(v))
+    parts = {f"w{i}": s.snapshot() for i, s in enumerate(shards)}
+    merged, _ = obs_fleet.merge_registry_snapshots(parts)
+    cell = merged["h"]["values"][0]
+    bound = 10 ** (1 / 16) - 1
+    for key, q in (("p50", 50), ("p95", 95), ("p99", 99)):
+        exact = float(np.percentile(samples, q))
+        rel = abs(cell[key] - exact) / exact
+        assert rel <= bound + 1e-9, (key, cell[key], exact, rel)
+    assert cell["min"] == float(samples.min())
+    assert cell["max"] == float(samples.max())
+
+
+def test_merge_refuses_mismatched_geometry():
+    r1, r2 = MetricsRegistry(), MetricsRegistry()
+    r1.histogram("h", "x").observe(0.01)
+    r2.histogram("h", "x", bounds=(0.001, 0.1, 10.0)).observe(0.01)
+    merged, unmergeable = obs_fleet.merge_registry_snapshots(
+        {"a": r1.snapshot(), "b": r2.snapshot()}
+    )
+    assert unmergeable == ["h"]
+    assert "h" not in merged
+
+
+def test_fleet_prometheus_preserves_worker_labels():
+    r1, r2 = MetricsRegistry(), MetricsRegistry()
+    for reg, n in ((r1, 3), (r2, 5)):
+        for _ in range(n):
+            reg.histogram("lat", "x").observe(0.01, op="topk")
+        reg.counter("tot", "x").inc(n)
+    text = obs_fleet.render_fleet_prometheus(
+        {"w0": r1.snapshot(), "w1": r2.snapshot()}
+    )
+    assert '# TYPE lat histogram' in text
+    assert 'worker="w0"' in text and 'worker="w1"' in text
+    # cumulative le buckets end at +Inf == _count, per worker (the
+    # `le` label renders last — the extra slot, as in export.py)
+    for wid, n in (("w0", 3), ("w1", 5)):
+        assert (
+            f'lat_bucket{{op="topk",worker="{wid}",le="+Inf"}} {n}'
+            in text
+        )
+        assert f'lat_count{{op="topk",worker="{wid}"}} {n}' in text
+        assert f'tot{{worker="{wid}"}} {n}' in text
+
+
+# -- trace wire context ----------------------------------------------------
+
+
+def test_wire_context_roundtrip_and_sampling_decision():
+    t = Tracer(enabled=True)
+    with t.span("root") as root:
+        wire = to_wire(root.context)
+    ctx = from_wire(wire)
+    assert (ctx.trace_id, ctx.span_id) == (root.trace_id, root.span_id)
+    # sampled-out propagates the dropped sentinel: activating it
+    # suppresses every span (and never starts a fresh head)
+    dropped = from_wire({"sampled": False})
+    with t.activate(dropped):
+        with t.span("suppressed") as s:
+            assert s is None
+    assert from_wire(None) is None and from_wire({}) is None
+    assert to_wire(None) == {}
+    assert to_wire(None, sampled=False) == {"sampled": False}
+
+
+def test_remote_parent_stitches_across_tracers():
+    """Two Tracer instances = two processes: globally-unique ids, the
+    child adopting the remote trace id, and the merged audit seeing one
+    stitched cross-process trace with zero broken links."""
+    ta, tb = Tracer(enabled=True), Tracer(enabled=True)
+    with ta.span("router.request") as root:
+        wire = to_wire(root.context)
+    with tb.span("worker.request", parent=from_wire(wire)) as child:
+        assert child.trace_id == root.trace_id
+        assert child.parent_id == root.span_id
+        assert child.span_id != root.span_id
+    parts = [
+        {**ta.export_state(), "pid": 1000},
+        {**tb.export_state(), "pid": 2000},
+    ]
+    audit = obs_fleet.audit_fleet_traces(parts)
+    assert audit["cross_process_traces"] == 1
+    assert audit["stitched_cross_process"] == 1
+    assert audit["broken_parent_links"] == 0
+    # a dangling parent reference IS a broken link
+    parts[1]["spans"][0]["parent_id"] = 424242
+    audit = obs_fleet.audit_fleet_traces(parts)
+    assert audit["broken_parent_links"] == 1
+    assert audit["stitched_cross_process"] == 0
+
+
+# -- protocol: trace op, remote activation, request_id echo ----------------
+
+
+@pytest.fixture(scope="module")
+def svc():
+    hin = synthetic_hin(48, 80, 4, seed=2)
+    mp = compile_metapath("APVPA", hin.schema)
+    service = PathSimService(
+        create_backend("numpy", hin, mp),
+        config=ServeConfig(max_wait_ms=1.0, warm=False),
+    )
+    yield service
+    service.close()
+
+
+@pytest.fixture()
+def tracing():
+    obs.configure(metrics=True, tracing=True, trace_sample=1)
+    obs.get_tracer().clear()
+    yield obs.get_tracer()
+    obs.configure(metrics=True, tracing=False, trace_sample=1)
+    obs.get_tracer().clear()
+
+
+def test_handle_request_adopts_remote_trace(svc, tracing):
+    remote = Tracer(enabled=True)
+    with remote.span("router.dispatch") as att:
+        wire_ctx = to_wire(att.context)
+    resp = handle_request(
+        svc, {"id": 1, "op": "topk", "row": 3, "k": 4,
+              "trace": wire_ctx, "request_id": "rT"},
+    )
+    assert resp["ok"] and resp["request_id"] == "rT"
+    spans = tracing.spans()
+    assert spans, "remote-parented request produced no spans"
+    assert all(s.trace_id == att.trace_id for s in spans)
+    op_span = next(s for s in spans if s.name == "serve.op")
+    assert op_span.parent_id == att.span_id
+    # sampled-out context: zero spans anywhere downstream
+    tracing.clear()
+    resp = handle_request(
+        svc, {"id": 2, "op": "topk", "row": 3,
+              "trace": {"sampled": False}},
+    )
+    assert resp["ok"] and tracing.spans() == []
+
+
+def test_trace_op_exports_ring(svc, tracing):
+    handle_request(svc, {"id": 1, "op": "topk", "row": 1})
+    resp = handle_request(
+        svc, {"id": 2, "op": "trace", "request_id": "rX", "limit": 50}
+    )
+    assert resp["ok"] and resp["request_id"] == "rX"
+    part = resp["result"]
+    assert part["pid"] == os.getpid()
+    assert part["spans"] and "wall_anchor_us" in part
+    names = {s["name"] for s in part["spans"]}
+    assert "serve.request" in names
+
+
+def test_protocol_ops_echo_request_id(svc):
+    """Every registered op (the lint-enforced registry) echoes
+    request_id — on success AND on per-request failure — so the
+    router's dedup/hedge machinery can always correlate responses."""
+    minimal = {
+        "topk": {"row": 1}, "scores": {"row": 1},
+        "update": {"add_edges": [
+            {"rel": "author_of", "src_row": 0, "dst_row": 0}
+        ]},
+    }
+    assert "trace" in PROTOCOL_OPS
+    for op in sorted(PROTOCOL_OPS):
+        req = {"id": 1, "op": op, "request_id": f"rq-{op}",
+               **minimal.get(op, {})}
+        resp = handle_request(svc, req)
+        assert resp.get("request_id") == f"rq-{op}", (op, resp)
+        # and the error path echoes too
+        bad = handle_request(
+            svc, {"id": 2, "op": op, "request_id": f"re-{op}",
+                  "deadline_ms": -1.0, **minimal.get(op, {})}
+        )
+        assert bad.get("request_id") == f"re-{op}", (op, bad)
+        assert not bad["ok"] and bad.get("deadline_exceeded")
+
+
+# -- SLO engine ------------------------------------------------------------
+
+
+def _avail_snapshot(ok: float, err: float) -> dict:
+    return {
+        "dpathsim_router_requests_total": {
+            "type": "counter", "help": "",
+            "values": [
+                {"labels": {"outcome": "ok"}, "value": ok},
+                {"labels": {"outcome": "error"}, "value": err},
+            ],
+        },
+    }
+
+
+def test_slo_multiwindow_burn_alerts():
+    spec = obs_slo.SLOSpec(
+        name="avail", kind="availability",
+        metric="dpathsim_router_requests_total", objective=0.99,
+        good_labels=(("outcome", "ok"),),
+        windows=((10.0, 10.0), (30.0, 5.0)),
+    )
+    alerts = []
+    eng = obs_slo.SLOEngine((spec,), on_alert=alerts.append,
+                            min_alert_gap_s=0.0)
+    # healthy traffic: no alert
+    eng.observe(_avail_snapshot(0, 0), 0.0)
+    eng.observe(_avail_snapshot(1000, 1), 5.0)
+    assert alerts == []
+    # ~35% errors over both windows (burn ≈ 35x the 1% budget, past
+    # both thresholds) → fires once
+    eng.observe(_avail_snapshot(1100, 600), 10.0)
+    assert len(alerts) == 1
+    assert alerts[0]["slo"] == "avail"
+    assert all(b > 10.0 for b in alerts[0]["burn"].values())
+    snap = eng.snapshot()["avail"]
+    assert snap["alerts"] == 1 and snap["status"] == "burning"
+    # burn subsides: no new errors, fresh windows see clean traffic
+    eng.observe(_avail_snapshot(5000, 600), 45.0)
+    eng.observe(_avail_snapshot(9000, 600), 50.0)
+    assert len(alerts) == 1
+    assert eng.snapshot()["avail"]["status"] == "ok"
+
+
+def test_slo_requires_every_window_burning():
+    """A short-window spike that the long window hasn't confirmed must
+    NOT alert — that's the whole point of multi-window burn rates."""
+    spec = obs_slo.SLOSpec(
+        name="avail", kind="availability",
+        metric="dpathsim_router_requests_total", objective=0.99,
+        good_labels=(("outcome", "ok"),),
+        windows=((5.0, 10.0), (60.0, 20.0)),
+    )
+    alerts = []
+    eng = obs_slo.SLOEngine((spec,), on_alert=alerts.append,
+                            min_alert_gap_s=0.0)
+    # a long healthy history...
+    eng.observe(_avail_snapshot(0, 0), 0.0)
+    for i in range(1, 11):
+        eng.observe(_avail_snapshot(1000 * i, 0), 5.0 * i)
+    # ...then a short 30%-error burst: short window burns 30x (>10),
+    # long window only ~3%/1% = 3x (<20) → quiet
+    eng.observe(_avail_snapshot(10200, 100), 53.0)
+    assert alerts == []
+    burns = eng.snapshot()["avail"]["burn"]
+    assert burns["5s"] > 10.0 and burns["60s"] < 20.0
+
+
+def test_slo_latency_good_counts_from_merged_histogram():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat", "x")
+    for v in (0.001,) * 90 + (1.0,) * 10:
+        h.observe(v)
+    merged, _ = obs_fleet.merge_registry_snapshots({"w0": reg.snapshot()})
+    spec = obs_slo.SLOSpec(
+        name="lat", kind="latency", metric="lat",
+        objective=0.99, threshold=0.010,
+    )
+    good, total = obs_slo.good_total_from_snapshot(spec, merged)
+    assert total == 100
+    # conservative bucketing: every 1ms sample counts good, every 1s
+    # sample bad (no bucket bound ≤ 10ms contains them)
+    assert good == 90
+
+
+def test_slo_gauge_floor_judges_worst_replica():
+    r1, r2 = MetricsRegistry(), MetricsRegistry()
+    r1.gauge("recall", "x").set(1.0)
+    r2.gauge("recall", "x").set(0.5)
+    merged, _ = obs_fleet.merge_registry_snapshots(
+        {"a": r1.snapshot(), "b": r2.snapshot()}
+    )
+    spec = obs_slo.SLOSpec(
+        name="recall", kind="gauge_floor", metric="recall",
+        objective=0.5, threshold=0.98,
+    )
+    good, total = obs_slo.good_total_from_snapshot(spec, merged)
+    assert (good, total) == (0.0, 1.0)  # the 0.5 replica fails the floor
+
+
+def test_slo_specs_from_json_roundtrip():
+    text = json.dumps([{
+        "name": "lat", "kind": "latency", "metric": "m",
+        "objective": 0.95, "threshold": 0.1,
+        "windows": [[5, 2.0], [60, 1.0]],
+        "labels": {"op": "topk"},
+    }])
+    (spec,) = obs_slo.specs_from_json(text)
+    assert spec.windows == ((5.0, 2.0), (60.0, 1.0))
+    assert spec.labels == (("op", "topk"),)
+    with pytest.raises(ValueError, match="unknown SLO spec fields"):
+        obs_slo.specs_from_json(json.dumps([{
+            "name": "x", "kind": "latency", "metric": "m",
+            "objective": 0.9, "threshold": 1.0, "typo_field": 1,
+        }]))
+    with pytest.raises(ValueError, match="objective"):
+        obs_slo.SLOSpec(name="x", kind="availability", metric="m",
+                        objective=1.0)
+
+
+# -- flight recorder -------------------------------------------------------
+
+
+def test_flight_ring_bound_and_dump(tmp_path):
+    fr = FlightRecorder(capacity=4)
+    t = Tracer(enabled=True)
+    kept_tid = None
+    for i in range(10):
+        with t.span(f"req{i}") as s:
+            kept_tid = s.trace_id
+        fr.keep(["error"], trace_id=s.trace_id, rid=f"r{i}")
+    snap = fr.snapshot()
+    assert snap["kept_total"] == 10 and snap["dropped"] == 6
+    assert len(snap["records"]) == 4
+    path = str(tmp_path / "flight.json")
+    info = fr.dump(path, [t.export_state()])
+    assert info["records"] == 4
+    doc = json.loads(open(path, encoding="utf-8").read())
+    assert len(doc["records"]) == 4
+    dumped_tids = {
+        s["trace_id"] for part in doc["spans"] for s in part["spans"]
+    }
+    assert kept_tid in dumped_tids
+    # only KEPT traces survive the filter (6 were evicted)
+    assert len(dumped_tids) == 4
+
+
+# -- router integration (inproc fleet) -------------------------------------
+
+
+@pytest.fixture(scope="module")
+def hin():
+    return synthetic_hin(96, 160, 6, seed=5)
+
+
+@pytest.fixture(scope="module")
+def metapath(hin):
+    return compile_metapath("APVPA", hin.schema)
+
+
+def _fleet(hin, metapath, n=2, **cfg):
+    transports = {}
+    for i in range(n):
+        wid = f"w{i}"
+        service = PathSimService(
+            create_backend("numpy", hin, metapath),
+            config=ServeConfig(max_wait_ms=1.0, warm=False),
+        )
+        transports[wid] = InprocTransport(
+            wid, WorkerRuntime(service, worker_id=wid)
+        )
+    cfg.setdefault("heartbeat_interval_s", 0.05)
+    cfg.setdefault("hedge_ms", None)
+    cfg.setdefault("scrape_interval_s", 0.0)
+    router = Router(transports, RouterConfig(**cfg))
+    router.start()
+    return router, transports
+
+
+def _close(router, transports):
+    router.close()
+    for t in transports.values():
+        t.runtime.service.close()
+
+
+def test_router_root_dispatch_worker_spans_one_trace(hin, metapath,
+                                                     tracing):
+    router, transports = _fleet(hin, metapath)
+    try:
+        resp = router.request({"id": 1, "op": "topk", "row": 7, "k": 5},
+                              timeout=20)
+        assert resp["ok"]
+        for _ in range(100):
+            names = {s.name for s in tracing.spans()}
+            if {"router.request", "router.dispatch", "worker.request",
+                    "serve.request"} <= names:
+                break
+            time.sleep(0.01)
+        spans = tracing.spans()
+        root = next(s for s in spans if s.name == "router.request")
+        dispatch = next(s for s in spans if s.name == "router.dispatch")
+        worker = next(s for s in spans if s.name == "worker.request")
+        assert dispatch.parent_id == root.span_id
+        assert dispatch.args["kind"] == "primary"
+        assert worker.parent_id == dispatch.span_id
+        # everything the request produced shares the root's trace id
+        tree = [s for s in spans if s.trace_id == root.trace_id]
+        by_id = {s.span_id: s for s in tree}
+        for s in tree:
+            if s.parent_id is not None:
+                assert s.parent_id in by_id, s.name
+    finally:
+        _close(router, transports)
+
+
+def test_router_failover_sibling_attempt_spans(hin, metapath, tracing):
+    router, transports = _fleet(hin, metapath, n=3)
+    try:
+        futs = [
+            router.submit({"id": i, "op": "topk", "row": i % 96, "k": 5})
+            for i in range(40)
+        ]
+        transports["w1"].kill()
+        assert all(f.result(timeout=30)["ok"] for f in futs)
+        spans = tracing.spans()
+        by_trace: dict[int, list] = {}
+        for s in spans:
+            if s.name == "router.dispatch":
+                by_trace.setdefault(s.trace_id, []).append(s)
+        multi = [v for v in by_trace.values() if len(v) > 1]
+        assert multi, "the kill must have produced failover re-dispatch"
+        attempts = multi[0]
+        kinds = [s.args["kind"] for s in attempts]
+        assert "failover" in kinds
+        # siblings: every attempt parents to the same root span
+        assert len({s.parent_id for s in attempts}) == 1
+        # flight recorder kept the failed-over requests with their
+        # trace ids resolvable in the ring
+        recs = [r for r in router.flight.records()
+                if "failover" in r["reasons"]]
+        assert recs and all(r["trace_id"] is not None for r in recs)
+    finally:
+        _close(router, transports)
+
+
+def test_flight_retention_100pct_while_head_sampling(hin, metapath):
+    """The tail-sampling contract: with head sampling at 1/4, EVERY
+    errored request is still retained by the flight recorder, while
+    the span ring holds roughly a quarter of the request traces."""
+    obs.configure(metrics=True, tracing=True, trace_sample=4)
+    obs.get_tracer().clear()
+    router, transports = _fleet(hin, metapath)
+    try:
+        n_ok, n_bad = 40, 12
+        for i in range(n_ok):
+            assert router.request(
+                {"id": i, "op": "topk", "row": i % 96, "k": 5},
+                timeout=20,
+            )["ok"]
+        for i in range(n_bad):
+            resp = router.request(
+                {"id": 100 + i, "op": "topk", "row": 10**9, "k": 5},
+                timeout=20,
+            )
+            assert not resp["ok"]
+        errored = [r for r in router.flight.records()
+                   if "error" in r["reasons"]]
+        assert len(errored) == n_bad  # 100% retention, sampling or not
+        roots = [s for s in obs.get_tracer().spans()
+                 if s.name == "router.request"]
+        total = n_ok + n_bad
+        assert len(roots) <= math.ceil(total / 4) + 1
+        assert len(roots) >= total // 4 - 1
+        # sampled-out errored requests keep a record with no trace id
+        assert any(r["trace_id"] is None for r in errored)
+    finally:
+        _close(router, transports)
+        obs.configure(metrics=True, tracing=False, trace_sample=1)
+        obs.get_tracer().clear()
+
+
+def test_router_scrape_merge_and_fleet_metrics_op(hin, metapath):
+    router, transports = _fleet(hin, metapath, scrape_interval_s=0.1)
+    try:
+        for i in range(10):
+            assert router.request(
+                {"id": i, "op": "topk", "row": i % 96, "k": 5},
+                timeout=20,
+            )["ok"]
+        resp = router.submit({
+            "id": 9, "op": "fleet_metrics", "request_id": "rq-fm",
+        }).result(timeout=20)
+        assert resp["ok"] and resp["request_id"] == "rq-fm"
+        fm = resp["result"]
+        assert sorted(fm["workers_scraped"]) == ["w0", "w1"]
+        assert fm["unmergeable"] == []
+        assert "availability" in fm["slo"]
+        assert fm["router"]["obs"]["flight_kept"] == 0
+        # inproc workers share one process registry, so each scraped
+        # snapshot reports the same request totals — the merge then
+        # sums them (documented: the fleet plane assumes per-process
+        # registries; subprocess workers are the real deployment)
+        fam = fm["merged"].get("dpathsim_request_seconds")
+        assert fam and sum(c["count"] for c in fam["values"]) > 0
+        # flight_dump op: inline snapshot + request_id echo
+        resp = router.submit({
+            "id": 10, "op": "flight_dump", "request_id": "rq-fd",
+        }).result(timeout=20)
+        assert resp["ok"] and resp["request_id"] == "rq-fd"
+        assert resp["result"]["kept_total"] == 0
+    finally:
+        _close(router, transports)
+
+
+def test_router_slow_requests_tail_kept(hin, metapath):
+    router, transports = _fleet(hin, metapath, slow_ms=0.0)
+    try:
+        assert router.request(
+            {"id": 1, "op": "topk", "row": 3, "k": 5}, timeout=20
+        )["ok"]
+        recs = router.flight.records()
+        assert recs and "slow" in recs[0]["reasons"]
+    finally:
+        _close(router, transports)
+
+
+def test_ann_refresh_emits_linked_root_span(tracing):
+    """The background re-embed runs as its own trace whose root names
+    the spawning update's span ('link'), and the index refresh spans
+    nest under it — the §24 'linked spans' contract."""
+    from distributed_pathsim_tpu.data.delta import with_headroom
+
+    small = with_headroom(synthetic_hin(64, 100, 4, seed=3), 0.25)
+    mp = compile_metapath("APVPA", small.schema)
+    service = PathSimService(
+        create_backend("numpy", small, mp),
+        config=ServeConfig(max_wait_ms=1.0, warm=False,
+                           topk_mode="ann", ann_shadow_every=0),
+    )
+    try:
+        ap = service.hin.blocks["author_of"]
+        resp = handle_request(service, {
+            "id": 1, "op": "update",
+            "remove_edges": [{
+                "rel": "author_of",
+                "src_row": int(ap.rows[0]), "dst_row": int(ap.cols[0]),
+            }],
+        })
+        assert resp["ok"] and resp["result"]["mode"] == "delta"
+        for _ in range(400):
+            spans = {s.name: s for s in tracing.spans()}
+            if "ann.refresh" in spans:
+                break
+            time.sleep(0.01)
+        refresh = spans["ann.refresh"]
+        op_span = next(
+            s for s in tracing.spans()
+            if s.name == "serve.op" and s.args.get("op") == "update"
+        )
+        assert refresh.args["link"] == (
+            f"{op_span.trace_id}:{op_span.span_id}"
+        )
+        # its own trace (a background job), not a child of the update
+        assert refresh.trace_id != op_span.trace_id
+        for _ in range(400):
+            names = {s.name for s in tracing.spans()}
+            if "index.refresh_rows" in names:
+                break
+            time.sleep(0.01)
+        embed = next(s for s in tracing.spans()
+                     if s.name == "index.refresh_embed")
+        assert embed.trace_id == refresh.trace_id
+    finally:
+        service.close()
+
+
+# -- CLI surface -----------------------------------------------------------
+
+
+def test_worker_argv_forwards_suffixed_artifact_paths():
+    from distributed_pathsim_tpu.router.cli import build_router_parser
+
+    args = build_router_parser().parse_args([
+        "--dataset", "synthetic:authors=10,papers=20,venues=2,seed=0",
+        "--backend", "numpy",
+        "--metrics-file", "/tmp/fleet.prom",
+        "--trace-out", "/tmp/trace.json",
+        "--metrics", "/tmp/events.jsonl",
+        "--trace-sample", "8",
+    ])
+    argv = _worker_argv(args, 1)
+    assert "--metrics-file" in argv
+    assert argv[argv.index("--metrics-file") + 1] == "/tmp/fleet.w1.prom"
+    assert argv[argv.index("--trace-out") + 1] == "/tmp/trace.w1.json"
+    assert argv[argv.index("--metrics") + 1] == "/tmp/events.w1.jsonl"
+    assert argv[argv.index("--trace-sample") + 1] == "8"
+    assert _suffix_path("noext", "w0") == "noext.w0"
+
+
+def test_fleet_stats_renders(hin, metapath):
+    router, transports = _fleet(hin, metapath, scrape_interval_s=0.1)
+    try:
+        for i in range(6):
+            router.request({"id": i, "op": "topk", "row": i, "k": 5},
+                           timeout=20)
+        fm = router.fleet_metrics(refresh=True)
+        text = obs_fleet.render_fleet_stats(fm)
+        assert "fleet: 2 workers (2 up)" in text
+        assert "w0" in text and "w1" in text
+        assert "slo:" in text and "availability" in text
+        # the merged latency tables: the router's submit→resolve view
+        # (outcome=ok rows) and the worker topk path (outcome=dispatch
+        # — the async worker loop's serve-layer histogram)
+        lines = text.splitlines()
+        router_i = next(i for i, ln in enumerate(lines)
+                        if ln.startswith("router latency"))
+        assert any(ln.startswith("ok") for ln in lines[router_i:][:8])
+        serve_i = next(i for i, ln in enumerate(lines)
+                       if ln.startswith("serve latency"))
+        assert any(ln.startswith("dispatch")
+                   for ln in lines[serve_i:][:8])
+    finally:
+        _close(router, transports)
+
+
+def test_lint_rules_cover_index_and_obs(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        import lint_telemetry as lt
+    finally:
+        sys.path.remove(os.path.join(REPO, "scripts"))
+    bad = tmp_path / "bad.py"
+    bad.write_text("print('hello')\n", encoding="utf-8")
+    hits = lt.scan_file(bad, "index/bad.py")
+    assert any(v.rule == "index-raw-print" for v in hits)
+    hits = lt.scan_file(bad, "obs/bad.py")
+    assert any(v.rule == "obs-raw-print" for v in hits)
+    # the sanctioned CLI file stays allowed
+    assert not lt.scan_file(bad, "index/cli.py")
+    # and the registry check is active + currently clean
+    assert lt.check_protocol_registry() == []
+
+
+# -- the full smoke (make fleet-obs-smoke) ---------------------------------
+
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_fleet_obs_smoke():
+    """``make fleet-obs-smoke`` as a tier-1 test: real router + 2
+    worker subprocesses, closed-loop load, one mid-load SIGKILL —
+    stitched cross-process trace with zero broken parent links, exact
+    merged counts, SLO burn on the injected latency fault, flight
+    recorder catching the failover, zero lost / zero added compiles,
+    per-worker artifact forwarding."""
+    sys.path.insert(0, REPO)
+    try:
+        import bench_serving
+
+        result = bench_serving.run_fleet_obs_smoke()
+    finally:
+        sys.path.remove(REPO)
+    assert all(result["smoke_checks"].values()), result["smoke_checks"]
