@@ -4,8 +4,8 @@
 PYTHON ?= python
 
 .PHONY: test chaos chaos-router serve-smoke update-smoke obs-smoke \
-	router-smoke ann-smoke fleet-obs-smoke lint-telemetry tune-smoke \
-	lint-tuning tune
+	router-smoke ann-smoke fleet-obs-smoke lint lint-telemetry \
+	tune-smoke lint-tuning tune
 
 # Tier-1: the fast CPU suite (the driver's acceptance gate).
 test:
@@ -91,9 +91,18 @@ obs-smoke:
 fleet-obs-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) bench_serving.py --regime fleet-obs --smoke
 
-# Telemetry discipline: no wall-clock durations, no raw stderr prints
-# in library code, no event-sink bypasses. Also a non-slow pytest
-# (tests/test_obs.py::test_lint_telemetry), so tier-1 covers it.
+# Unified static analysis (analysis/, DESIGN.md §25): recompile-safety,
+# lock-discipline, determinism, and wire-contract passes over the
+# package + scripts + tests, with one checked-in baseline. Exits
+# nonzero on any non-baselined finding (expired/stale baseline entries
+# included). Also a non-slow pytest
+# (tests/test_analysis.py::test_repo_is_clean), so tier-1 covers it.
+lint:
+	$(PYTHON) -m distributed_pathsim_tpu.cli lint
+
+# DEPRECATED (one release): the telemetry rules migrated into `make
+# lint` (DT003/TL001/TL002/WC001/WC003/WC004); this target execs the
+# shim that re-runs exactly those passes.
 lint-telemetry:
 	$(PYTHON) scripts/lint_telemetry.py
 
@@ -105,10 +114,8 @@ lint-telemetry:
 tune-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/tune_sweep.py --smoke
 
-# Tuning discipline: new hardcoded tile/bucket constants outside
-# tuning/registry.py are rejected (that's how the pre-tuning
-# heuristics fossilized). Also a non-slow pytest
-# (tests/test_tuning.py::test_lint_tuning), so tier-1 covers it.
+# DEPRECATED (one release): the tuning-constant rule migrated into
+# `make lint` (TN001); this target execs the shim that re-runs it.
 lint-tuning:
 	$(PYTHON) scripts/lint_tuning.py
 

@@ -1,0 +1,39 @@
+"""Unified invariant-checking static analysis (DESIGN.md §25).
+
+One framework, one suppression story, for every machine-checkable
+contract this repo's value proposition rests on:
+
+- **recompile-safety** (recompile.py): tuning-knob resolution stays
+  outside cached-jit cores, pad/shape decisions go through the
+  sanctioned bucket helpers, static args stay hashable — the PR-3/PR-5
+  zero-steady-state-recompile contracts, checked at the AST instead of
+  only by compile-counter smoke tests.
+- **lock discipline** (locks.py): for every class owning a
+  ``threading.Lock``/``RLock``, attributes written under the lock must
+  not be touched on paths that provably don't hold it.
+- **determinism** (determinism.py): no unordered set/dict iteration
+  into fingerprints/wire payloads, no score selection outside the
+  shared ops/pathsim primitives, no wall-clock or unseeded RNG in
+  deterministic paths.
+- **wire contract** (wire.py): every protocol op registered in
+  ``PROTOCOL_OPS``, wire-field reads defaulted (old clients keep
+  working), stdout of wire-owning processes print-free.
+- **telemetry** (telemetry.py) and **tuning constants**
+  (tuning_constants.py): the migrated ``scripts/lint_telemetry.py`` /
+  ``scripts/lint_tuning.py`` rules, absorbed so there is ONE analyzer.
+
+Run it as ``dpathsim lint`` or ``make lint``; see core.py for the
+Finding model, baseline semantics, and renderers.
+"""
+
+from .core import (  # noqa: F401
+    Finding,
+    Module,
+    default_roots,
+    load_baseline,
+    load_modules,
+    render_human,
+    render_json,
+    run_analysis,
+)
+from .registry import ALL_PASSES, MIGRATED_RULES, RULES  # noqa: F401

@@ -1,0 +1,133 @@
+"""Small shared AST helpers for the analysis passes."""
+
+from __future__ import annotations
+
+import ast
+
+
+def dotted(node: ast.AST) -> str | None:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(node: ast.Call) -> str | None:
+    """Dotted name of a call's callee, else None."""
+    return dotted(node.func)
+
+
+def self_attr(node: ast.AST) -> str | None:
+    """``X`` when ``node`` is exactly ``self.X``, else None."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def is_print_call(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Name)
+        and node.func.id == "print"
+    )
+
+
+def print_stream(node: ast.Call) -> str:
+    """'stdout' | 'stderr' | 'other' for a print() call's file= target."""
+    for kw in node.keywords:
+        if kw.arg == "file":
+            name = dotted(kw.value)
+            if name == "sys.stderr":
+                return "stderr"
+            if name == "sys.stdout":
+                return "stdout"
+            return "other"
+    return "stdout"
+
+
+def walk_functions(tree: ast.Module):
+    """Yield every (qualname, FunctionDef) in the module, nested defs
+    and methods included."""
+
+    def visit(node: ast.AST, prefix: str):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                name = f"{prefix}.{child.name}" if prefix else child.name
+                yield name, child
+                yield from visit(child, name)
+            elif isinstance(child, ast.ClassDef):
+                name = f"{prefix}.{child.name}" if prefix else child.name
+                yield from visit(child, name)
+            else:
+                yield from visit(child, prefix)
+
+    yield from visit(tree, "")
+
+
+def own_nodes(fn: ast.AST):
+    """Walk a function's OWN body: descendants excluding nested
+    function/lambda bodies (each nested def is analyzed in its own
+    right by walk_functions, with its own context)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def jit_decorated(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> bool:
+    """True for ``@jax.jit``, ``@jit``, and
+    ``@functools.partial(jax.jit, ...)`` / ``@partial(jax.jit, ...)``."""
+    for dec in fn.decorator_list:
+        name = dotted(dec)
+        if name in ("jax.jit", "jit"):
+            return True
+        if isinstance(dec, ast.Call):
+            cn = call_name(dec)
+            if cn in ("functools.partial", "partial") and dec.args:
+                if dotted(dec.args[0]) in ("jax.jit", "jit"):
+                    return True
+            if cn in ("jax.jit", "jit"):
+                return True
+    return False
+
+
+def static_argnames(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> list[str]:
+    """The static_argnames string list of a jit decorator, if spelled
+    as literals."""
+    out: list[str] = []
+    for dec in fn.decorator_list:
+        if not isinstance(dec, ast.Call):
+            continue
+        cn = call_name(dec)
+        args = dec.keywords
+        if cn in ("functools.partial", "partial") and dec.args:
+            if dotted(dec.args[0]) not in ("jax.jit", "jit"):
+                continue
+        elif cn not in ("jax.jit", "jit"):
+            continue
+        for kw in args:
+            if kw.arg != "static_argnames":
+                continue
+            value = kw.value
+            elts = (
+                value.elts
+                if isinstance(value, (ast.Tuple, ast.List, ast.Set))
+                else [value]
+            )
+            for e in elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    out.append(e.value)
+    return out

@@ -1,0 +1,89 @@
+"""``dpathsim lint``: run the unified analyzer, exit nonzero on findings.
+
+Usage::
+
+    dpathsim lint                     # all rules, baseline applied
+    dpathsim lint --rules LD001,LD002 # one pass's rules only
+    dpathsim lint --json              # stable sorted JSON (diffable)
+    dpathsim lint --no-baseline       # raw findings, suppressions off
+    dpathsim lint --list-rules        # the rule catalog
+
+Exit codes: 0 clean (baseline-suppressed findings don't fail), 1 any
+non-baselined finding (including expired/stale baseline entries), 2
+usage errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="dpathsim lint",
+        description="unified invariant-checking static analysis "
+        "(recompile-safety, lock-discipline, determinism, "
+        "wire-contract; DESIGN.md §25)",
+    )
+    p.add_argument(
+        "--rules", default=None,
+        help="comma-separated rule ids to run (default: all)",
+    )
+    p.add_argument(
+        "--json", action="store_true",
+        help="machine-readable output: sorted findings, sorted keys — "
+        "byte-stable across runs for diffing",
+    )
+    p.add_argument(
+        "--baseline", default=None,
+        help="baseline/suppression file "
+        "(default: distributed_pathsim_tpu/analysis/baseline.json)",
+    )
+    p.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore the baseline: report every finding",
+    )
+    p.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule catalog and exit",
+    )
+    return p
+
+
+def lint_main(argv: list[str] | None = None) -> int:
+    from .core import (
+        load_baseline,
+        render_human,
+        render_json,
+        run_analysis,
+    )
+    from .registry import RULES
+
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        for rid in sorted(RULES):
+            doc = RULES[rid]
+            print(f"{rid}  [{doc.pass_name}] {doc.title}")
+        return 0
+    rules = None
+    if args.rules:
+        rules = {r.strip() for r in args.rules.split(",") if r.strip()}
+        unknown = rules - set(RULES) - {"BASELINE"}
+        if unknown:
+            print(
+                f"error: unknown rule(s) {sorted(unknown)}; see "
+                "--list-rules", file=sys.stderr,
+            )
+            return 2
+    baseline = None if args.no_baseline else load_baseline(args.baseline)
+    if baseline is not None and rules is not None:
+        # a rule filter must not turn the other rules' suppressions
+        # into "stale entry" findings
+        baseline = [e for e in baseline if e.get("rule") in rules]
+    result = run_analysis(rules=rules, baseline=baseline)
+    if args.json:
+        print(render_json(result))
+    else:
+        print(render_human(result))
+    return 1 if result["findings"] else 0

@@ -1,0 +1,205 @@
+"""Recompile-safety pass: the zero-steady-state-recompile contracts.
+
+The serving tier's latency story rests on two PR-3/PR-5 invariants that
+until now only compile-counter smoke tests enforced *after the fact*:
+
+- **RS001 knob-in-jit**: tuning-knob resolution
+  (``tuning.dispatch.choose()`` and friends) must happen OUTSIDE any
+  ``@jax.jit``-decorated (or ``_*_jit``-named) core. A knob resolved
+  inside a traced function is frozen into the compiled program — the
+  table changes, the program silently doesn't (and re-tracing to honor
+  it would be exactly the steady-state recompile the contract forbids).
+- **RS002 unbucketed-shape**: in the serving tier (``serving/``, the
+  index probe path), batch padding and device-shape construction go
+  through the sanctioned bucket helpers (``bucket_for``/``pad_rows``/
+  ``bucket_ladder``/``resolve_ladder``). A raw ``np.pad``/``jnp.pad``
+  or a ``jnp.zeros(len(...))``-style Python-value-dependent shape in a
+  function that never consults the ladder compiles one program per
+  distinct size — the unbounded-compile regression the pow-2 buckets
+  exist to prevent.
+- **RS003 mutable-static-arg**: a jit ``static_argnames`` parameter
+  whose default or annotation is a list/dict/set is unhashable — it
+  fails at call time at best, and at worst invites "fix" by
+  list→tuple conversion per call, defeating the compile cache.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .astutil import call_name, jit_decorated, static_argnames, walk_functions
+from .core import Finding, Module
+
+RULE_DOCS = {
+    "RS001": (
+        "tuning-knob resolution inside a jitted core",
+        "choose()/active_table() inside a traced function freezes the "
+        "knob at trace time — resolve knobs before entering the jitted "
+        "core (see tuning/dispatch.py's contract)",
+    ),
+    "RS002": (
+        "unbucketed pad/shape in the serving tier",
+        "serving-tier shapes must come from the bucket ladder "
+        "(bucket_for/pad_rows/resolve_ladder) — a Python-value-"
+        "dependent shape compiles one XLA program per distinct size",
+    ),
+    "RS003": (
+        "unhashable static argument on a jitted function",
+        "static_argnames values are compile-cache keys and must be "
+        "hashable — a list/dict/set default or annotation will fail "
+        "(or invite per-call conversions that defeat the cache)",
+    ),
+}
+
+_KNOB_CALLS = frozenset({
+    "choose", "dispatch.choose", "tuning.choose",
+    "active_table", "dispatch.active_table",
+    "install_table", "install_from_env",
+})
+_BUCKET_HELPERS = frozenset({
+    "bucket_for", "pad_rows", "bucket_ladder", "resolve_ladder",
+    "bk.bucket_for", "bk.pad_rows", "bk.bucket_ladder",
+    "buckets.bucket_for", "buckets.pad_rows", "buckets.bucket_ladder",
+})
+# the helpers themselves (and the registry's one implementation) are
+# where the raw pad/shape code is SUPPOSED to live
+_HELPER_DEFS = frozenset({
+    "bucket_for", "pad_rows", "bucket_ladder", "resolve_ladder",
+})
+_PAD_CALLS = frozenset({"np.pad", "jnp.pad", "numpy.pad"})
+_SHAPE_CTORS = frozenset({
+    "jnp.zeros", "jnp.ones", "jnp.full", "jnp.empty", "jnp.arange",
+})
+_RS002_SCOPE = ("serving/", "index/mips.py")
+
+
+def _jit_functions(tree: ast.Module):
+    for qual, fn in walk_functions(tree):
+        if jit_decorated(fn) or fn.name.endswith("_jit"):
+            yield qual, fn
+
+
+class RecompileSafetyPass:
+    rules = RULE_DOCS
+
+    def run(self, modules: list[Module]) -> list[Finding]:
+        findings: list[Finding] = []
+        for m in modules:
+            if m.root_kind == "tests":
+                continue
+            self._rs001(m, findings)
+            if m.root_kind == "package" and m.rel.startswith(_RS002_SCOPE):
+                self._rs002(m, findings)
+            self._rs003(m, findings)
+        return findings
+
+    def _rs001(self, m: Module, findings: list[Finding]) -> None:
+        for qual, fn in _jit_functions(m.tree):
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                cn = call_name(node) or ""
+                if cn in _KNOB_CALLS or cn.endswith(".dispatch.choose"):
+                    findings.append(Finding(
+                        path=m.repo_rel, line=node.lineno, rule="RS001",
+                        symbol=qual,
+                        message=(
+                            f"{cn}() inside jitted core {fn.name!r} — "
+                            "the knob's value is frozen at trace time; "
+                            "resolve it in the wrapper, pass it in as "
+                            "a static arg"
+                        ),
+                    ))
+
+    def _rs002(self, m: Module, findings: list[Finding]) -> None:
+        for qual, fn in walk_functions(m.tree):
+            if fn.name in _HELPER_DEFS:
+                continue
+            params = {a.arg for a in fn.args.args + fn.args.kwonlyargs}
+            bucket_sane = "bucket" in params or any(
+                isinstance(n, ast.Call)
+                and (call_name(n) or "") in _BUCKET_HELPERS
+                for n in ast.walk(fn)
+            )
+            if bucket_sane:
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                cn = call_name(node) or ""
+                if cn in _PAD_CALLS:
+                    findings.append(Finding(
+                        path=m.repo_rel, line=node.lineno, rule="RS002",
+                        symbol=qual,
+                        message=(
+                            f"{cn}() without a bucket-ladder-derived "
+                            "size — pad through serving.buckets."
+                            "pad_rows/bucket_for so the compiled-shape "
+                            "set stays bounded"
+                        ),
+                    ))
+                elif cn in _SHAPE_CTORS and any(
+                    isinstance(sub, ast.Call)
+                    and call_name(sub) == "len"
+                    for a in node.args[:1]
+                    for sub in ast.walk(a)
+                ):
+                    findings.append(Finding(
+                        path=m.repo_rel, line=node.lineno, rule="RS002",
+                        symbol=qual,
+                        message=(
+                            f"{cn}(len(...)) — a Python-value-dependent "
+                            "device shape compiles per distinct size; "
+                            "round it through the bucket ladder"
+                        ),
+                    ))
+
+    def _rs003(self, m: Module, findings: list[Finding]) -> None:
+        for qual, fn in walk_functions(m.tree):
+            statics = set(static_argnames(fn))
+            if not statics or not jit_decorated(fn):
+                continue
+            for a in fn.args.args + fn.args.kwonlyargs:
+                if a.arg not in statics:
+                    continue
+                ann = a.annotation
+                if isinstance(ann, ast.Subscript):
+                    base = (call_name(ann.value) if isinstance(
+                        ann.value, ast.Call) else None) or (
+                        ann.value.id if isinstance(ann.value, ast.Name)
+                        else None
+                    )
+                    if base in ("list", "dict", "set", "List", "Dict",
+                                "Set"):
+                        findings.append(Finding(
+                            path=m.repo_rel, line=a.lineno, rule="RS003",
+                            symbol=qual,
+                            message=(
+                                f"static arg {a.arg!r} annotated as "
+                                f"unhashable {base} — static args are "
+                                "compile-cache keys; use a tuple/"
+                                "frozenset"
+                            ),
+                        ))
+            defaults = fn.args.defaults
+            pos = fn.args.args
+            pairs = list(zip(pos[len(pos) - len(defaults):], defaults))
+            pairs += [
+                (a, d) for a, d in zip(fn.args.kwonlyargs,
+                                       fn.args.kw_defaults)
+                if d is not None
+            ]
+            for a, d in pairs:
+                if a.arg in statics and isinstance(
+                    d, (ast.List, ast.Dict, ast.Set, ast.DictComp,
+                        ast.ListComp, ast.SetComp)
+                ):
+                    findings.append(Finding(
+                        path=m.repo_rel, line=d.lineno, rule="RS003",
+                        symbol=qual,
+                        message=(
+                            f"static arg {a.arg!r} defaults to an "
+                            "unhashable literal — static args are "
+                            "compile-cache keys; use a tuple/frozenset"
+                        ),
+                    ))

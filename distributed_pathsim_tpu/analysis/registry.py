@@ -1,0 +1,60 @@
+"""Rule catalog: every pass, every rule id, and the migration map.
+
+``RULES`` is the one authoritative id → doc table (the CLI's
+``--list-rules``, the human renderer's "why" lines, and the test
+suite's fixture-coverage assertion all read it). ``MIGRATED_RULES``
+records which legacy ad-hoc lint rule each unified rule subsumes — the
+subsumption test walks it to prove the old scripts' checks all
+survived the migration.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from .determinism import DeterminismPass
+from .locks import LockDisciplinePass
+from .recompile import RecompileSafetyPass
+from .telemetry import TelemetryPass
+from .tuning_constants import TuningConstantsPass
+from .wire import WireContractPass
+
+
+@dataclasses.dataclass(frozen=True)
+class RuleDoc:
+    id: str
+    title: str
+    why: str
+    pass_name: str
+
+
+ALL_PASSES = (
+    RecompileSafetyPass(),
+    LockDisciplinePass(),
+    DeterminismPass(),
+    WireContractPass(),
+    TelemetryPass(),
+    TuningConstantsPass(),
+)
+
+RULES: dict[str, RuleDoc] = {}
+for _p in ALL_PASSES:
+    for _rid, (_title, _why) in _p.rules.items():
+        RULES[_rid] = RuleDoc(
+            id=_rid, title=_title, why=_why,
+            pass_name=type(_p).__name__,
+        )
+
+# legacy rule (scripts/lint_telemetry.py, scripts/lint_tuning.py) →
+# the unified rule that subsumes it
+MIGRATED_RULES: dict[str, str] = {
+    "wall-clock-duration": "DT003",       # lint_telemetry R1
+    "raw-stderr-print": "TL001",          # lint_telemetry R2
+    "event-sink-bypass": "TL002",         # lint_telemetry R3
+    "raw-stream-write": "WC004",          # lint_telemetry R4
+    "router-raw-print": "WC003",          # lint_telemetry R5
+    "index-raw-print": "WC003",           # lint_telemetry R6
+    "obs-raw-print": "WC003",             # lint_telemetry R7
+    "protocol-op-registry": "WC001",      # lint_telemetry R8
+    "hardcoded-tuning-constant": "TN001", # lint_tuning
+}

@@ -221,6 +221,20 @@ def main(argv: list[str] | None = None) -> int:
             msg = exc.args[0] if exc.args else exc
             print(f"error: {msg}", file=sys.stderr)
             return 1
+    if argv and argv[0] == "lint":
+        # ``dpathsim lint`` — the unified invariant-checking static
+        # analyzer (analysis/): recompile-safety, lock-discipline,
+        # determinism, and wire-contract passes with one baseline/
+        # suppression story (DESIGN.md §25). Pure AST work: never
+        # initializes a backend.
+        from .analysis.cli import lint_main
+
+        try:
+            return lint_main(argv[1:])
+        except (KeyError, ValueError, FileNotFoundError) as exc:
+            msg = exc.args[0] if exc.args else exc
+            print(f"error: {msg}", file=sys.stderr)
+            return 1
     if argv and argv[0] == "tune":
         # ``dpathsim tune`` — offline autotuner: measure every knob's
         # candidate arms on THIS device and write the dispatch table

@@ -321,8 +321,11 @@ class Router:
                 f"workers disagree on the base graph: {tokens} — every "
                 "replica must serve the same dataset/config"
             )
-        self._epochs.append(_Epoch(token=base))
-        self._epoch_by_token[tuple(base)] = 0
+        # transports are live (reader threads deliver _on_message, which
+        # touches the epoch log under the lock) — so hold it here too
+        with self._lock:
+            self._epochs.append(_Epoch(token=base))
+            self._epoch_by_token[tuple(base)] = 0
         # pong clocks start NOW, not at construction: worker startup
         # (backend build + warmup) happens between __init__ and here,
         # and counting it as silence would mark every worker stalled
@@ -356,9 +359,14 @@ class Router:
             self._draining = True
         deadline = time.monotonic() + self.config.drain_timeout_s
         clean = True
+        with self._lock:
+            # seed the accounting: a zero/negative drain timeout must
+            # still report the LIVE backlog it abandons
+            pending, updates = len(self._pending), len(self._updates)
         while time.monotonic() < deadline:
             with self._lock:
-                if not self._pending and not self._updates:
+                pending, updates = len(self._pending), len(self._updates)
+                if not pending and not updates:
                     break
             time.sleep(0.005)
         else:
@@ -374,8 +382,7 @@ class Router:
                 except Exception:
                     pass
         runtime_event(
-            "router_drain", clean=clean,
-            pending=len(self._pending), updates=len(self._updates),
+            "router_drain", clean=clean, pending=pending, updates=updates,
         )
         return clean
 
@@ -386,7 +393,9 @@ class Router:
         dict. Raises :class:`RouterShed` at the admission bound."""
         op = req.get("op", "topk")
         fut: Future = Future()
-        if self._draining:
+        with self._lock:
+            draining = self._draining
+        if draining:
             fut.set_result({
                 "id": req.get("id"), "ok": False, "error": "draining",
                 "draining": True,

@@ -134,7 +134,9 @@ class AnnState:
         read this; only the answering path (:meth:`eligible`) counts —
         otherwise one degraded request would tick the fallback counter
         once per onlooker."""
-        if not self.enabled:
+        with self._lock:
+            enabled = self.enabled
+        if not enabled:
             return "low_confidence"
         if not self.index.covers(row):
             return "stale" if 0 <= row < self.index.n else "uncovered"
@@ -268,13 +270,14 @@ class AnnState:
             )
             if tripped:
                 self.enabled = False
+            samples = self.shadow_n
         self._m_recall.set(ratio)
         if tripped:
             runtime_event(
                 "ann_confidence_lost",
                 recall=round(ratio, 4),
                 floor=self.recall_floor,
-                samples=self.shadow_n,
+                samples=samples,
             )
 
     def close(self) -> None:

@@ -237,4 +237,5 @@ class HotTileCache:
 
     @property
     def bytes_used(self) -> int:
-        return self._bytes
+        with self._lock:
+            return self._bytes

@@ -910,16 +910,40 @@ class PathSimService:
             # background job, not a request), LINKED to the update that
             # scheduled it via the ``link`` arg ("trace:span")
             with get_tracer().span("ann.refresh", link=link):
-                # an abandoned attempt (a newer delta landed mid-fold)
-                # retries against the newer token — deltas that arrived
-                # while we were the debounced in-flight refresh must
-                # not be left stale until some future update happens by
-                while self.refresh_index().get("abandoned"):
-                    pass
+                while True:
+                    # an abandoned attempt (a newer delta landed
+                    # mid-fold) retries against the newer token —
+                    # deltas that arrived while we were the debounced
+                    # in-flight refresh must not be left stale until
+                    # some future update happens by
+                    result = self.refresh_index()
+                    if result.get("abandoned"):
+                        continue
+                    # Close the debounce window: a delta landing after
+                    # refresh_index released the swap lock but before
+                    # we clear the flag saw inflight=True and skipped
+                    # scheduling — its staleness is ours to absorb.
+                    # Re-check under the lock that owns both the flag
+                    # and the index; only hand the flag back when no
+                    # refreshable staleness remains. The progress guard
+                    # (refreshed > 0) keeps rows refresh CANNOT clear
+                    # (unsupported embedding, unplaced) from spinning
+                    # this thread forever.
+                    with self._swap_lock:
+                        ann = self._ann
+                        more = (
+                            ann is not None
+                            and ann.index.meta.get("embedding") == "struct"
+                            and ann.index.stale_count
+                            and result.get("refreshed", 0) > 0
+                        )
+                        if not more:
+                            self._ann_refresh_inflight = False
+                            return
         except Exception as exc:  # background thread: report, never die
             runtime_event("ann_refresh_failed", error=repr(exc))
-        finally:
-            self._ann_refresh_inflight = False
+            with self._swap_lock:
+                self._ann_refresh_inflight = False
 
     def refresh_index(self) -> dict:
         """Re-embed every delta-staled index row in place and advance

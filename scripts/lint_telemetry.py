@@ -1,202 +1,31 @@
 #!/usr/bin/env python
-"""Telemetry discipline lint: keep the obs subsystem the only door.
+"""DEPRECATED shim: telemetry lint moved into the unified analyzer.
 
-The observability layer (obs/) only stays trustworthy if new code can't
-quietly bypass it. Three rules, each one a regression class this repo
-has actually had:
-
-R1  ``time.time()`` outside the sanctioned sites. Wall clock is for
-    humans; durations and orderings use ``perf_counter``/``monotonic``
-    (wall time steps under NTP — a duration computed from it can be
-    negative). Sanctioned: ``utils/logging.py`` (the ``timestamps()``
-    helper stamping JSONL ``ts``) and ``obs/trace.py`` (the tracer's
-    one wall anchor mapping monotonic spans onto epoch time).
-
-R2  ``print(..., file=sys.stderr)`` outside the CLI surface. Library
-    code reporting through raw stderr prints is invisible to the JSONL
-    sink, the obs counters, AND can interleave mid-line across threads
-    — that's what ``runtime_event`` exists for. Sanctioned: the CLI
-    modules' user-facing one-liners (error renderings, banners) and
-    ``utils/logging.py`` itself.
-
-R3  ``_EVENT_SINK`` outside ``utils/logging.py``. Writing to the sink
-    directly skips the lock, the obs event counter, and the stderr
-    echo policy — the exact bypass the sink's lock exists to prevent.
-
-R6/R7 (ISSUE 9) extend the raw-print discipline to the ``index/`` and
-``obs/`` subsystems: index background refreshes run inside serving
-workers whose stdout IS the JSONL wire, and the obs package is the
-reporting layer itself — a print inside either is invisible to the
-sink and can corrupt a worker's protocol stream. ``index/cli.py``'s
-user-facing JSON output is the one sanctioned site.
-
-R8 is structural: every op string ``serving/protocol._dispatch_op``
-handles must be registered in ``PROTOCOL_OPS`` — the registry the
-request_id-echo test (tests/test_fleet_obs.py) iterates — so a new
-protocol op cannot land without proving the router's retry/hedge/dedup
-machinery can correlate its responses.
-
-Runs as ``make lint-telemetry`` and as a non-slow pytest
-(tests/test_obs.py::test_lint_telemetry), so tier-1 catches a new
-violation the moment it lands.
+The rules this script enforced now live in
+``distributed_pathsim_tpu/analysis/`` (run them with ``dpathsim lint``
+or ``make lint``): R1 → DT003, R2 → TL001, R3 → TL002, R4 → WC004,
+R5/R6/R7 → WC003, R8 → WC001 (see ``analysis.registry.MIGRATED_RULES``).
+This entry point execs the migrated passes so ``make lint-telemetry``
+and the pytest hook keep working for one release, then it goes away.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import pathlib
-import re
 import sys
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
 PACKAGE = REPO / "distributed_pathsim_tpu"
 
-
-@dataclasses.dataclass(frozen=True)
-class Rule:
-    name: str
-    pattern: re.Pattern
-    why: str
-    # relative paths (from the package root) wholly exempt from the rule
-    allowed_files: frozenset[str]
-    # when set, the rule applies only to files under this prefix
-    # (package-relative) — for subsystem-scoped discipline
-    only_under: str | None = None
-
-
-RULES = (
-    Rule(
-        name="wall-clock-duration",
-        pattern=re.compile(r"\btime\.time\(\)"),
-        why=(
-            "time.time() is wall clock — durations/ordering must use "
-            "perf_counter/monotonic; stamp events via "
-            "utils.logging.timestamps()"
-        ),
-        allowed_files=frozenset({"utils/logging.py", "obs/trace.py"}),
-    ),
-    Rule(
-        name="raw-stderr-print",
-        pattern=re.compile(r"print\([^)]*file\s*=\s*sys\.stderr"),
-        why=(
-            "library code reports through runtime_event() (JSONL sink + "
-            "obs counter + locked stderr), not raw stderr prints"
-        ),
-        allowed_files=frozenset(
-            {"utils/logging.py", "cli.py", "serving/cli.py",
-             "neural_cli.py", "router/cli.py"}
-        ),
-    ),
-    Rule(
-        name="event-sink-bypass",
-        pattern=re.compile(r"_EVENT_SINK"),
-        why=(
-            "the event sink is private to utils/logging.py — emitting "
-            "through it directly skips the lock and the obs counters; "
-            "call runtime_event()"
-        ),
-        allowed_files=frozenset({"utils/logging.py"}),
-    ),
-    Rule(
-        name="raw-stream-write",
-        pattern=re.compile(r"sys\.std(err|out)\.write"),
-        why=(
-            "direct stream writes skip the event sink's lock (stderr) "
-            "or corrupt a JSONL wire protocol (stdout) — events go "
-            "through runtime_event(), protocol lines through the "
-            "loop's locked writer"
-        ),
-        allowed_files=frozenset({"utils/logging.py"}),
-    ),
-    Rule(
-        name="router-raw-print",
-        pattern=re.compile(r"(?<![\w.])print\("),
-        why=(
-            "the router/worker processes OWN stdout as the JSONL wire "
-            "— a stray print corrupts the protocol and bypasses the "
-            "locked sink; use runtime_event() (events) or the loop's "
-            "locked emit (protocol lines)"
-        ),
-        allowed_files=frozenset({"router/cli.py"}),
-        only_under="router/",
-    ),
-    Rule(
-        name="index-raw-print",
-        pattern=re.compile(r"(?<![\w.])print\("),
-        why=(
-            "index/ code runs inside serving workers whose stdout IS "
-            "the JSONL wire (background refresh threads, in-process "
-            "builds) — report through runtime_event(); index/cli.py's "
-            "user-facing JSON output is the one sanctioned site"
-        ),
-        allowed_files=frozenset({"index/cli.py"}),
-        only_under="index/",
-    ),
-    Rule(
-        name="obs-raw-print",
-        pattern=re.compile(r"(?<![\w.])print\("),
-        why=(
-            "obs/ IS the reporting layer — a print inside it bypasses "
-            "the very sink/counter discipline it exists to provide "
-            "(and obs code runs inside workers whose stdout is the "
-            "wire); return strings for the CLI surface to print"
-        ),
-        allowed_files=frozenset(),
-        only_under="obs/",
-    ),
-)
-
-# -- R8: protocol-op registry (structural, not a line regex) ----------------
-#
-# serving/protocol.py must register every op its dispatch table handles
-# in PROTOCOL_OPS: the registry is what the request_id-echo test
-# (tests/test_fleet_obs.py::test_protocol_ops_echo_request_id) iterates,
-# so an unregistered op is an op whose responses the router's
-# retry/hedge/dedup machinery was never proven able to correlate.
-
-_OP_COMPARE = re.compile(r"\bop\s*==\s*\"([a-z_]+)\"")
-_REGISTRY = re.compile(
-    r"PROTOCOL_OPS\s*=\s*frozenset\(\{(.*?)\}\)", re.DOTALL
-)
-
-
-def check_protocol_registry() -> list[Violation]:
-    path = PACKAGE / "serving" / "protocol.py"
-    try:
-        text = path.read_text(encoding="utf-8")
-    except OSError:
-        return []
-    m = _REGISTRY.search(text)
-    registered = set(re.findall(r"\"([a-z_]+)\"", m.group(1))) if m else set()
-    out: list[Violation] = []
-    if not m:
-        out.append(Violation(
-            rule="protocol-op-registry",
-            path="distributed_pathsim_tpu/serving/protocol.py", line=1,
-            text="PROTOCOL_OPS registry missing",
-            why="protocol.py must declare PROTOCOL_OPS (the op registry "
-            "the request_id-echo test iterates)",
-        ))
-    for i, line in enumerate(text.splitlines(), 1):
-        for op in _OP_COMPARE.findall(line):
-            if op not in registered:
-                out.append(Violation(
-                    rule="protocol-op-registry",
-                    path="distributed_pathsim_tpu/serving/protocol.py",
-                    line=i, text=line,
-                    why=f"op {op!r} handled but not registered in "
-                    "PROTOCOL_OPS — register it so the request_id-echo "
-                    "test covers it",
-                ))
-    return out
-
-# print(...) spanning lines would dodge a per-line regex; scan whole
-# files with a multiline-tolerant pass instead of per-line matching.
-_COMMENT = re.compile(r"^\s*#")
+# the migrated rule ids this shim re-runs (the old R1–R8 vocabulary)
+_RULES = {"DT003", "TL001", "TL002", "WC003", "WC004", "WC001"}
 
 
 @dataclasses.dataclass(frozen=True)
 class Violation:
+    """Old-shape violation (kept for the pytest hook's rendering)."""
+
     rule: str
     path: str
     line: int
@@ -210,49 +39,142 @@ class Violation:
         )
 
 
-def scan_file(path: pathlib.Path, rel: str) -> list[Violation]:
-    out: list[Violation] = []
-    try:
-        lines = path.read_text(encoding="utf-8").splitlines()
-    except OSError:
-        return out
-    for rule in RULES:
-        if rel in rule.allowed_files:
-            continue
-        if rule.only_under is not None and not rel.startswith(rule.only_under):
-            continue
-        for i, line in enumerate(lines, 1):
-            if _COMMENT.match(line):
-                continue
-            if rule.pattern.search(line):
-                out.append(
-                    Violation(
-                        rule=rule.name, path=f"distributed_pathsim_tpu/{rel}",
-                        line=i, text=line, why=rule.why,
-                    )
-                )
-    return out
+# new rule id → the legacy rule name this shim's callers still expect
+_OLD_NAMES = {
+    "DT003": "wall-clock-duration",
+    "TL001": "raw-stderr-print",
+    "TL002": "event-sink-bypass",
+    "WC004": "raw-stream-write",
+    "WC001": "protocol-op-registry",
+}
+_OLD_PRINT_NAMES = (
+    ("router/", "router-raw-print"),
+    ("index/", "index-raw-print"),
+    ("obs/", "obs-raw-print"),
+)
+
+
+def _old_name(rule: str, path: str) -> str:
+    if rule == "WC003":
+        for prefix, name in _OLD_PRINT_NAMES:
+            if f"distributed_pathsim_tpu/{prefix}" in path or \
+                    path.startswith(prefix):
+                return name
+        return "raw-print"
+    return _OLD_NAMES.get(rule, rule)
+
+
+def _to_violations(findings, rules_doc) -> list[Violation]:
+    return [
+        Violation(
+            rule=_old_name(f.rule, f.path), path=f.path, line=f.line,
+            text=f.symbol,
+            why=(
+                rules_doc[f.rule].why if f.rule in rules_doc
+                else f.message
+            ),
+        )
+        for f in findings
+    ]
+
+
+def _baseline_for(rules: set[str]) -> list[dict]:
+    """The unified baseline, filtered to these rules: a suppression
+    that satisfies `make lint` must satisfy the shim too (one
+    suppression story). Stale/expired-entry enforcement stays the
+    unified analyzer's job — the shim only honors suppressions."""
+    from distributed_pathsim_tpu.analysis import load_baseline
+
+    return [e for e in load_baseline() if e.get("rule") in rules]
 
 
 def scan_package() -> list[Violation]:
-    violations: list[Violation] = []
-    for path in sorted(PACKAGE.rglob("*.py")):
-        rel = path.relative_to(PACKAGE).as_posix()
-        violations.extend(scan_file(path, rel))
-    violations.extend(check_protocol_registry())
-    return violations
+    sys.path.insert(0, str(REPO))
+    try:
+        from distributed_pathsim_tpu.analysis import (
+            RULES,
+            load_modules,
+            run_analysis,
+        )
+    finally:
+        sys.path.pop(0)
+    modules = load_modules({"package": PACKAGE}, repo=REPO)
+    result = run_analysis(
+        rules=_RULES, modules=modules, repo=REPO,
+        baseline=_baseline_for(_RULES),
+    )
+    findings = [f for f in result["findings"] if f.rule != "BASELINE"]
+    return _to_violations(findings, RULES)
+
+
+def _single_module(path: pathlib.Path, rel: str):
+    """Old API compat: one file, analyzed AS IF at package-relative
+    ``rel`` (tests feed synthetic files through subsystem-scoped
+    rules this way)."""
+    import ast
+
+    sys.path.insert(0, str(REPO))
+    try:
+        from distributed_pathsim_tpu.analysis.core import Module
+    finally:
+        sys.path.pop(0)
+    text = pathlib.Path(path).read_text(encoding="utf-8")
+    return Module(
+        path=pathlib.Path(path), rel=rel,
+        repo_rel=f"distributed_pathsim_tpu/{rel}",
+        root_kind="package", text=text, tree=ast.parse(text),
+    )
+
+
+def scan_file(path: pathlib.Path, rel: str) -> list[Violation]:
+    """DEPRECATED old API: per-line rules of the legacy script, via
+    the migrated passes (WC001 is package-structural and excluded,
+    matching the old scan_file which also ran it separately)."""
+    sys.path.insert(0, str(REPO))
+    try:
+        from distributed_pathsim_tpu.analysis import RULES, run_analysis
+    finally:
+        sys.path.pop(0)
+    result = run_analysis(
+        rules=_RULES - {"WC001"},
+        modules=[_single_module(path, rel)], repo=REPO,
+    )
+    return _to_violations(result["findings"], RULES)
+
+
+def check_protocol_registry() -> list[Violation]:
+    """DEPRECATED old API: just the op-registry check (now WC001)."""
+    sys.path.insert(0, str(REPO))
+    try:
+        from distributed_pathsim_tpu.analysis import (
+            RULES,
+            load_modules,
+            run_analysis,
+        )
+    finally:
+        sys.path.pop(0)
+    modules = load_modules({"package": PACKAGE}, repo=REPO)
+    result = run_analysis(
+        rules={"WC001"}, modules=modules, repo=REPO,
+        baseline=_baseline_for({"WC001"}),
+    )
+    findings = [f for f in result["findings"] if f.rule != "BASELINE"]
+    return _to_violations(findings, RULES)
 
 
 def main() -> int:
+    print(
+        "lint_telemetry is deprecated: its rules moved to the unified "
+        "analyzer — run `dpathsim lint` / `make lint`",
+        file=sys.stderr,
+    )
     violations = scan_package()
     if not violations:
-        print(f"lint_telemetry: clean ({len(list(PACKAGE.rglob('*.py')))} "
-              "files scanned)")
+        print("lint_telemetry: clean (via dpathsim lint)")
         return 0
     for v in violations:
         print(v.render(), file=sys.stderr)
-    print(f"lint_telemetry: {len(violations)} violation(s)",
-          file=sys.stderr)
+    print(f"lint_telemetry: {len(violations)} violation(s)", file=sys.stderr)
     return 1
 
 

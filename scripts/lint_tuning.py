@@ -1,77 +1,28 @@
 #!/usr/bin/env python
-"""Tuning discipline lint: no new hardcoded tile/bucket constants.
+"""DEPRECATED shim: tuning-constant lint moved into the unified analyzer.
 
-The autotuning subsystem (distributed_pathsim_tpu/tuning/) exists
-because performance constants fossilize: ``_default_scores_tiles`` was
-promoted from an 8k sweep and silently lost to XLA at 32k
-(KERNELS_r05). The registry is now the one place a tile/bucket decision
-may live; this lint rejects NEW hardcoded ones elsewhere.
-
-Rule: any module-level or class-level assignment of an integer (or
-all-integer tuple) constant whose name contains a tile/bucket token —
-``TILE``, ``BUCKET``, ``LADDER``, ``STRIPE``, a bare ``BM``/``BN``/
-``BK`` name component, or an index-geometry token (``CAP``,
-``CENTROID``, ``NPROBE``) — must either live in ``tuning/registry.py`` or
-be listed in ``registry.SANCTIONED_CONSTANTS`` with its justification
-(kernel-internal layout invariants and the documented heuristic floors
-of registered knobs). Everything else is a knob trying to escape the
-registry.
-
-Runs as ``make lint-tuning`` and as a non-slow pytest
-(tests/test_tuning.py::test_lint_tuning), so tier-1 catches a new
-constant the moment it lands.
+The rule this script enforced is now ``TN001`` in
+``distributed_pathsim_tpu/analysis/tuning_constants.py`` (run it with
+``dpathsim lint --rules TN001`` or as part of ``make lint``). This
+entry point execs the migrated pass so ``make lint-tuning`` and the
+pytest hook keep working for one release, then it goes away.
 """
 
 from __future__ import annotations
 
-import ast
 import dataclasses
 import pathlib
-import re
 import sys
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
+# tests monkeypatch this to point the scan at a synthetic tree
 PACKAGE = REPO / "distributed_pathsim_tpu"
-
-# Files that ARE the tuning subsystem: constants there are the registry.
-_EXEMPT = ("tuning/",)
-
-_TOKENS = {
-    "TILE", "BUCKET", "LADDER", "STRIPE", "BM", "BN", "BK",
-    # index-geometry knobs (ann_cluster_cap / ann_centroids /
-    # ann_nprobe): a hardcoded cap or centroid count in index/serving
-    # code is the same fossilization the tile tokens guard against
-    "CAP", "CENTROID", "NPROBE",
-}
-_SPLIT = re.compile(r"[^A-Za-z0-9]+")
-
-
-def _name_matches(name: str) -> bool:
-    parts = {p.upper() for p in _SPLIT.split(name) if p}
-    # plural forms count too (BUCKETS, TILES, ...): a constant does not
-    # stop being a knob because it holds several values
-    parts |= {p[:-1] for p in parts if p.endswith("S")}
-    return bool(parts & _TOKENS)
-
-
-def _is_const_int(node: ast.AST) -> bool:
-    """An integer literal, possibly shifted/multiplied (the idiomatic
-    ``256 << 20`` budget spellings), or a tuple of them."""
-    if isinstance(node, ast.Constant):
-        return isinstance(node.value, int) and not isinstance(
-            node.value, bool
-        )
-    if isinstance(node, ast.Tuple):
-        return bool(node.elts) and all(_is_const_int(e) for e in node.elts)
-    if isinstance(node, ast.BinOp):
-        return _is_const_int(node.left) and _is_const_int(node.right)
-    if isinstance(node, ast.UnaryOp):
-        return _is_const_int(node.operand)
-    return False
 
 
 @dataclasses.dataclass(frozen=True)
 class Violation:
+    """Old-shape violation (the pytest hook reads ``.name``)."""
+
     path: str
     line: int
     name: str
@@ -86,50 +37,46 @@ class Violation:
         )
 
 
-def _const_assignments(tree: ast.Module):
-    """(name, lineno) for module-level and class-level constant int/
-    tuple assignments."""
-    scopes: list[ast.AST] = [tree]
-    scopes.extend(n for n in ast.walk(tree) if isinstance(n, ast.ClassDef))
-    for scope in scopes:
-        for stmt in scope.body:  # type: ignore[attr-defined]
-            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
-                tgt, value = stmt.targets[0], stmt.value
-            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
-                tgt, value = stmt.target, stmt.value
-            else:
-                continue
-            if isinstance(tgt, ast.Name) and _is_const_int(value):
-                yield tgt.id, stmt.lineno
-
-
 def scan_package() -> list[Violation]:
     sys.path.insert(0, str(REPO))
-    from distributed_pathsim_tpu.tuning.registry import SANCTIONED_CONSTANTS
-
-    violations: list[Violation] = []
-    for path in sorted(PACKAGE.rglob("*.py")):
-        rel = path.relative_to(PACKAGE).as_posix()
-        if any(rel.startswith(p) for p in _EXEMPT):
+    try:
+        from distributed_pathsim_tpu.analysis.core import (
+            apply_baseline,
+            load_baseline,
+            load_modules,
+        )
+        from distributed_pathsim_tpu.analysis.tuning_constants import (
+            scan_modules,
+        )
+    finally:
+        sys.path.pop(0)
+    modules = load_modules({"package": pathlib.Path(PACKAGE)}, repo=REPO)
+    # honor the unified baseline (one suppression story); the shim
+    # only suppresses — stale/expired enforcement is `make lint`'s job
+    entries = [e for e in load_baseline() if e.get("rule") == "TN001"]
+    kept, _ = apply_baseline(sorted(scan_modules(modules)), entries)
+    out = []
+    for f in kept:
+        if f.rule != "TN001":
             continue
+        rel = pathlib.Path(f.path)
         try:
-            tree = ast.parse(path.read_text(encoding="utf-8"))
-        except (OSError, SyntaxError):
-            continue
-        allowed = SANCTIONED_CONSTANTS.get(rel, frozenset())
-        for name, line in _const_assignments(tree):
-            if _name_matches(name) and name not in allowed:
-                violations.append(Violation(path=rel, line=line, name=name))
-    return violations
+            rel = rel.relative_to("distributed_pathsim_tpu")
+        except ValueError:
+            pass
+        out.append(Violation(path=rel.as_posix(), line=f.line, name=f.symbol))
+    return out
 
 
 def main() -> int:
+    print(
+        "lint_tuning is deprecated: its rule moved to the unified "
+        "analyzer (TN001) — run `dpathsim lint` / `make lint`",
+        file=sys.stderr,
+    )
     violations = scan_package()
     if not violations:
-        print(
-            f"lint_tuning: clean "
-            f"({len(list(PACKAGE.rglob('*.py')))} files scanned)"
-        )
+        print("lint_tuning: clean (via dpathsim lint)")
         return 0
     for v in violations:
         print(v.render(), file=sys.stderr)

@@ -1,0 +1,10 @@
+"""BAD: set iteration feeds a fingerprint (DT001)."""
+import hashlib
+
+
+def fingerprint(parts):
+    h = hashlib.sha256()
+    names = set(parts)
+    for name in names:
+        h.update(name.encode())
+    return h.hexdigest()

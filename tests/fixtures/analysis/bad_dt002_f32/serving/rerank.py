@@ -1,0 +1,9 @@
+"""BAD: float32 cast inside an f64 scoring path (DT002)."""
+import numpy as np
+
+from ..ops import pathsim
+
+
+def rerank(counts, d_src, d_cand):
+    scores = pathsim.score_candidates(counts, d_src, d_cand)
+    return np.float32(scores)

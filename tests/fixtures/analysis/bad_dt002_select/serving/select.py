@@ -1,0 +1,6 @@
+"""BAD: local argsort reimplements score selection (DT002)."""
+import numpy as np
+
+
+def pick_top(scores, k):
+    return np.argsort(-scores)[:k]

@@ -1,0 +1,6 @@
+"""BAD: wall clock where ordering/durations need monotonic (DT003)."""
+import time
+
+
+def stamp():
+    return time.time()

@@ -1,0 +1,7 @@
+"""BAD: unseeded RNG in package code (DT004)."""
+import numpy as np
+
+
+def sample(n):
+    rng = np.random.default_rng()
+    return rng.integers(0, 10, n)

@@ -1,0 +1,15 @@
+"""BAD: unlocked write to a lock-guarded attribute (LD001)."""
+import threading
+
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+
+    def add(self):
+        with self._lock:
+            self.count += 1
+
+    def reset(self):
+        self.count = 0

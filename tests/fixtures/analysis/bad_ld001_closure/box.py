@@ -1,0 +1,17 @@
+"""BAD: a closure's call site must not inherit the enclosing method's
+lock (the callback runs later, unlocked) — LD001 on the helper."""
+import threading
+
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+
+    def bump(self, executor):
+        with self._lock:
+            self.count += 1
+            executor.submit(lambda: self._helper())
+
+    def _helper(self):
+        self.count += 1

@@ -1,0 +1,17 @@
+"""BAD: the SECOND attribute of a tuple write under the lock is
+guarded too — LD001 on the unlocked clobber."""
+import threading
+
+
+class Pair:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.a = 0
+        self.b = 0
+
+    def set_both(self, x, y):
+        with self._lock:
+            self.a, self.b = x, y
+
+    def clobber(self):
+        self.b = 9

@@ -1,0 +1,12 @@
+"""BAD: tuning-knob resolution inside a jitted core (RS001)."""
+import functools
+
+import jax
+
+from ..tuning import dispatch
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _scores_jit(c, k):
+    bm, bn = dispatch.choose("scores_tile", n=8, default=(8, 8))
+    return c * bm * bn * k

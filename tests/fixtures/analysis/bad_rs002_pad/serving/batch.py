@@ -1,0 +1,6 @@
+"""BAD: raw pad to the natural batch size (RS002)."""
+import numpy as np
+
+
+def form_batch(rows):
+    return np.pad(rows, (0, 32 - len(rows)))

@@ -1,0 +1,6 @@
+"""BAD: Python-value-dependent device shape (RS002)."""
+import jax.numpy as jnp
+
+
+def form_batch(rows):
+    return jnp.zeros((len(rows), 4))

@@ -1,0 +1,6 @@
+"""BAD: raw stderr print in library code (TL001)."""
+import sys
+
+
+def warn(msg):
+    print("warning:", msg, file=sys.stderr)

@@ -1,0 +1,5 @@
+"""BAD: event-sink bypass (TL002)."""
+
+
+def emit(logging_mod, event):
+    logging_mod._EVENT_SINK.log(event)

@@ -1,0 +1,2 @@
+"""BAD: hardcoded tile constant outside the registry (TN001)."""
+_MY_TILE = 512

@@ -1,0 +1,10 @@
+"""BAD: op handled but not registered (WC001)."""
+PROTOCOL_OPS = frozenset({"ping"})
+
+
+def _dispatch_op(service, op, req):
+    if op == "ping":
+        return {"pong": True}
+    if op == "frobnicate":
+        return {"frobnicated": True}
+    raise KeyError(op)

@@ -1,0 +1,5 @@
+"""BAD: undefaulted wire-field read (WC002)."""
+
+
+def handle(req, reply):
+    reply({"request_id": req["request_id"]})

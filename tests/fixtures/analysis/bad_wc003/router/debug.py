@@ -1,0 +1,5 @@
+"""BAD: print in a wire-owning package (WC003)."""
+
+
+def announce(state):
+    print("router state:", state)

@@ -1,0 +1,6 @@
+"""BAD: raw stream write (WC004)."""
+import sys
+
+
+def emit(line):
+    sys.stdout.write(line + "\n")

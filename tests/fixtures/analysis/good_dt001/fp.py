@@ -1,0 +1,10 @@
+"""GOOD: sorted() pins the iteration order."""
+import hashlib
+
+
+def fingerprint(parts):
+    h = hashlib.sha256()
+    names = set(parts)
+    for name in sorted(names):
+        h.update(name.encode())
+    return h.hexdigest()

@@ -1,0 +1,6 @@
+"""GOOD: selection goes through the shared f64 primitives."""
+from ..ops import pathsim
+
+
+def pick_top(scores, k):
+    return pathsim.topk_from_score_rows(scores, k)

@@ -1,0 +1,8 @@
+"""GOOD: monotonic clock for durations."""
+import time
+
+
+def measure(fn):
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0

@@ -1,0 +1,7 @@
+"""GOOD: explicit seed."""
+import numpy as np
+
+
+def sample(n, seed):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 10, n)

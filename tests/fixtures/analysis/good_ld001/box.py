@@ -1,0 +1,20 @@
+"""GOOD: every touch of guarded state holds the lock — including via a
+private helper whose call sites all hold it (the held-method fixpoint)."""
+import threading
+
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+
+    def add(self):
+        with self._lock:
+            self._bump()
+
+    def _bump(self):
+        self.count += 1
+
+    def reset(self):
+        with self._lock:
+            self.count = 0

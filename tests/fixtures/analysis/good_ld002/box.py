@@ -1,0 +1,18 @@
+"""GOOD: reads hold the lock (and Conditions count as the lock)."""
+import threading
+
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ready = threading.Condition(self._lock)
+        self.count = 0
+
+    def add(self):
+        with self._lock:
+            self.count += 1
+            self._ready.notify()
+
+    def peek(self):
+        with self._ready:
+            return self.count

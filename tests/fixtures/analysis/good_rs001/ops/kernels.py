@@ -1,0 +1,16 @@
+"""GOOD: knob resolved in the wrapper, passed in as a static arg."""
+import functools
+
+import jax
+
+from ..tuning import dispatch
+
+
+def scores(c, k):
+    bm, bn = dispatch.choose("scores_tile", n=8, default=(8, 8))
+    return _scores_jit(c, k, bm, bn)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "bm", "bn"))
+def _scores_jit(c, k, bm, bn):
+    return c * bm * bn * k

@@ -1,0 +1,9 @@
+"""GOOD: batch shapes come from the bucket ladder."""
+import numpy as np
+
+from . import buckets as bk
+
+
+def form_batch(rows, ladder):
+    bucket = bk.bucket_for(len(rows), ladder)
+    return bk.pad_rows(np.asarray(rows), bucket)

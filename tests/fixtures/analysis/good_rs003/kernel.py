@@ -1,0 +1,9 @@
+"""GOOD: static args are hashable (tuple default)."""
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, static_argnames=("tiles",))
+def _fold_jit(x, tiles=(8, 8)):
+    return x * tiles[0]

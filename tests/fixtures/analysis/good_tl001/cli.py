@@ -1,0 +1,6 @@
+"""GOOD: the CLI surface may print to stderr."""
+import sys
+
+
+def warn(msg):
+    print("warning:", msg, file=sys.stderr)

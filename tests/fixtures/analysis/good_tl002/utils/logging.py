@@ -1,0 +1,7 @@
+"""GOOD: the sink is private to utils/logging.py."""
+_EVENT_SINK = None
+
+
+def runtime_event(event, **fields):
+    if _EVENT_SINK is not None:
+        _EVENT_SINK.log(event, **fields)

@@ -1,0 +1,2 @@
+"""GOOD: no knob-shaped constants outside the registry."""
+_MY_WIDTH = 512

@@ -1,0 +1,10 @@
+"""GOOD: every handled op is registered."""
+PROTOCOL_OPS = frozenset({"ping", "frobnicate"})
+
+
+def _dispatch_op(service, op, req):
+    if op == "ping":
+        return {"pong": True}
+    if op == "frobnicate":
+        return {"frobnicated": True}
+    raise KeyError(op)

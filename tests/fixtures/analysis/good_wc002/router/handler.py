@@ -1,0 +1,8 @@
+"""GOOD: defaulted / guarded wire-field reads."""
+
+
+def handle(req, reply):
+    rid = req.get("request_id")
+    if req.get("deadline_ms") is not None:
+        rid = (rid, req["deadline_ms"])
+    reply({"request_id": rid})

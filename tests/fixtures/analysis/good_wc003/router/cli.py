@@ -1,0 +1,5 @@
+"""GOOD: the CLI surface is the sanctioned print site."""
+
+
+def announce(state):
+    print("router state:", state)

@@ -1,0 +1,6 @@
+"""GOOD: utils/logging.py owns the raw stream writes."""
+import sys
+
+
+def emit(line):
+    sys.stderr.write(line + "\n")

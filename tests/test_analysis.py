@@ -1,0 +1,238 @@
+"""The unified static-analysis framework (analysis/, DESIGN.md §25).
+
+Four layers of coverage, all non-slow (tier-1 gates them):
+
+- **Fixture corpus** (tests/fixtures/analysis/): one minimal bad and
+  one minimal good snippet per rule. Each bad case must produce
+  EXACTLY its expected finding (no more, no other rule), each good
+  case zero — this is also the acceptance gate that injected
+  violations of every rule class (knob lookup inside a jitted core,
+  unlocked write to a guarded attribute, set iteration into a
+  fingerprint, unregistered protocol op, ...) are caught.
+- **Whole-repo run**: `dpathsim lint` over the real tree has zero
+  non-baselined findings, and finishes fast enough to gate tier-1
+  (< 10 s).
+- **Baseline semantics**: suppressions need reasons, expire loudly,
+  and stale entries (matching nothing) are themselves errors.
+- **Migration subsumption**: every rule the legacy
+  scripts/lint_telemetry.py / lint_tuning.py enforced maps to a
+  migrated pass with a firing fixture, so retiring the old scripts
+  loses no coverage.
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import pathlib
+import time
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+FIXTURES = REPO / "tests" / "fixtures" / "analysis"
+
+
+def _analyze_case(case_dir: pathlib.Path):
+    from distributed_pathsim_tpu.analysis import load_modules, run_analysis
+
+    modules = load_modules({"package": case_dir}, repo=case_dir)
+    assert modules, f"fixture case {case_dir.name} has no parsable files"
+    return run_analysis(modules=modules, repo=case_dir)["findings"]
+
+
+def _cases(prefix: str):
+    return sorted(
+        p for p in FIXTURES.iterdir() if p.is_dir() and p.name.startswith(prefix)
+    )
+
+
+def _expected_rule(case_name: str) -> str:
+    # bad_rs002_pad -> RS002
+    return case_name.split("_")[1].upper()
+
+
+@pytest.mark.parametrize(
+    "case", _cases("bad_"), ids=lambda p: p.name
+)
+def test_bad_fixture_produces_exactly_its_finding(case):
+    findings = _analyze_case(case)
+    rule = _expected_rule(case.name)
+    assert len(findings) == 1, (
+        f"{case.name}: expected exactly one {rule} finding, got "
+        + "; ".join(f.render() for f in findings)
+    )
+    assert findings[0].rule == rule, findings[0].render()
+
+
+@pytest.mark.parametrize(
+    "case", _cases("good_"), ids=lambda p: p.name
+)
+def test_good_fixture_is_clean(case):
+    findings = _analyze_case(case)
+    assert findings == [], "; ".join(f.render() for f in findings)
+
+
+def test_every_rule_has_fixture_coverage():
+    """Satellite contract: a corpus of good/bad snippets per rule —
+    a rule without a firing fixture is a rule free to rot."""
+    from distributed_pathsim_tpu.analysis import RULES
+
+    bad = {_expected_rule(p.name) for p in _cases("bad_")}
+    good = {_expected_rule(p.name) for p in _cases("good_")}
+    missing_bad = sorted(set(RULES) - bad)
+    missing_good = sorted(set(RULES) - good)
+    assert not missing_bad, f"rules with no bad fixture: {missing_bad}"
+    assert not missing_good, f"rules with no good fixture: {missing_good}"
+
+
+def test_repo_is_clean():
+    """The whole-repo gate: zero non-baselined findings after the
+    satellite fixes, fast enough to gate tier-1, and deterministic
+    (two runs render byte-identical JSON)."""
+    from distributed_pathsim_tpu.analysis import (
+        load_baseline,
+        render_json,
+        run_analysis,
+    )
+
+    t0 = time.perf_counter()
+    result = run_analysis(baseline=load_baseline())
+    elapsed = time.perf_counter() - t0
+    assert result["findings"] == [], "\n".join(
+        f.render() for f in result["findings"]
+    )
+    assert result["files"] > 100  # package + scripts + tests all walked
+    assert elapsed < 10.0, f"analyzer too slow to gate tier-1: {elapsed:.1f}s"
+    again = run_analysis(baseline=load_baseline())
+    assert render_json(result) == render_json(again)
+
+
+def test_findings_sorted_and_json_stable():
+    from distributed_pathsim_tpu.analysis import render_json, run_analysis
+
+    result = run_analysis(baseline=None)
+    keys = [(f.path, f.line, f.rule) for f in result["findings"]]
+    assert keys == sorted(keys)
+    doc = json.loads(render_json(result))
+    assert set(doc) == {"findings", "suppressed", "files"}
+
+
+def test_baseline_suppression_expiry_and_staleness():
+    from distributed_pathsim_tpu.analysis.core import Finding, apply_baseline
+
+    f = Finding(
+        path="pkg/x.py", line=3, rule="LD002", symbol="A.peek",
+        message="read of self.count without holding self._lock",
+    )
+    today = datetime.date(2026, 8, 4)
+    # 1. live entry suppresses
+    kept, supp = apply_baseline(
+        [f],
+        [{"rule": "LD002", "path": "pkg/x.py", "match": "self.count",
+          "reason": "racy by design"}],
+        today=today,
+    )
+    assert kept == [] and supp == [f]
+    # 2. expired entry stops suppressing AND reports itself
+    kept, supp = apply_baseline(
+        [f],
+        [{"rule": "LD002", "path": "pkg/x.py", "match": "self.count",
+          "reason": "racy by design", "expires": "2026-01-01"}],
+        today=today,
+    )
+    assert supp == []
+    rules = sorted(k.rule for k in kept)
+    assert rules == ["BASELINE", "LD002"]
+    assert any("expired" in k.message for k in kept)
+    # 3. entry matching nothing is a stale-suppression error
+    kept, supp = apply_baseline(
+        [],
+        [{"rule": "WC003", "path": "pkg/gone.py", "reason": "moved"}],
+        today=today,
+    )
+    assert [k.rule for k in kept] == ["BASELINE"]
+    assert "stale suppression" in kept[0].message
+    # 4. symbol narrows the match
+    kept, supp = apply_baseline(
+        [f],
+        [{"rule": "LD002", "path": "pkg/x.py", "symbol": "A.other",
+          "reason": "different method"}],
+        today=today,
+    )
+    assert f in kept  # not suppressed — and the entry reports stale
+    assert any(k.rule == "BASELINE" for k in kept)
+
+
+def test_baseline_requires_reason(tmp_path):
+    from distributed_pathsim_tpu.analysis import load_baseline
+
+    p = tmp_path / "baseline.json"
+    p.write_text(json.dumps(
+        {"suppressions": [{"rule": "LD001", "path": "x.py"}]}
+    ))
+    with pytest.raises(ValueError, match="reason"):
+        load_baseline(p)
+
+
+def test_migration_subsumption():
+    """Every rule the legacy lint scripts enforced survived the
+    migration: it maps to a unified rule that exists AND fires (has a
+    bad fixture). Retiring scripts/lint_telemetry.py /
+    scripts/lint_tuning.py loses no coverage."""
+    from distributed_pathsim_tpu.analysis import MIGRATED_RULES, RULES
+
+    legacy = {
+        # scripts/lint_telemetry.py R1–R8
+        "wall-clock-duration", "raw-stderr-print", "event-sink-bypass",
+        "raw-stream-write", "router-raw-print", "index-raw-print",
+        "obs-raw-print", "protocol-op-registry",
+        # scripts/lint_tuning.py
+        "hardcoded-tuning-constant",
+    }
+    assert legacy == set(MIGRATED_RULES)
+    bad = {_expected_rule(p.name) for p in _cases("bad_")}
+    for old, new in MIGRATED_RULES.items():
+        assert new in RULES, f"{old} migrated to unknown rule {new}"
+        assert new in bad, f"{old} -> {new} has no firing fixture"
+
+
+def test_legacy_shims_still_work(capsys):
+    """The deprecation shims keep `make lint-telemetry` /
+    `make lint-tuning` green for one release by exec'ing the migrated
+    passes."""
+    import subprocess
+    import sys
+
+    for script in ("lint_telemetry.py", "lint_tuning.py"):
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "scripts" / script)],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert "deprecated" in proc.stderr.lower()
+
+
+def test_cli_surface(capsys):
+    from distributed_pathsim_tpu.analysis.cli import lint_main
+
+    assert lint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rid in ("RS001", "LD001", "DT001", "WC001", "TN001"):
+        assert rid in out
+    assert lint_main(["--rules", "NOPE"]) == 2
+    capsys.readouterr()
+    # rule filter + baseline: LD002's suppressions apply, other rules'
+    # entries must not surface as stale
+    assert lint_main(["--rules", "LD002,LD001"]) == 0
+    out = capsys.readouterr().out
+    assert "0 finding(s)" in out
+
+
+def test_lint_runs_via_main_cli(capsys):
+    """`dpathsim lint` routes through the package CLI without touching
+    any backend."""
+    from distributed_pathsim_tpu.cli import main
+
+    assert main(["lint", "--rules", "WC001"]) == 0
+    assert "finding(s)" in capsys.readouterr().out
