@@ -4,8 +4,8 @@
 PYTHON ?= python
 
 .PHONY: test chaos chaos-router serve-smoke update-smoke obs-smoke \
-	router-smoke ann-smoke fleet-obs-smoke lint lint-telemetry \
-	tune-smoke lint-tuning tune
+	router-smoke partition-smoke ann-smoke fleet-obs-smoke lint \
+	lint-telemetry tune-smoke lint-tuning tune
 
 # Tier-1: the fast CPU suite (the driver's acceptance gate).
 test:
@@ -36,6 +36,19 @@ chaos-router:
 # (tests/test_router.py::test_bench_router_smoke), so tier-1 covers it.
 router-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) bench_serving.py --regime router --smoke
+
+# Partition smoke: ONE graph sharded across 3 real partition-worker
+# subprocesses (chained replication 2) behind `dpathsim router --mode
+# partition`. Hard gates: scatter-gather answers bit-identical to the
+# single-host oracle (top-k ids + f64 scores + a full scores row),
+# routed deltas stay oracle-exact, one mid-load SIGKILL → zero lost
+# requests and zero steady-state recompiles on the survivors, and the
+# measured per-worker slice shrinks as partitions grow (the max-N
+# curve). The same run is wired as a non-slow pytest
+# (tests/test_partition.py::test_bench_partition_smoke), so tier-1
+# covers it.
+partition-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) bench_serving.py --regime partition --smoke
 
 # Serving smoke: the closed-loop load generator on a small fixed-seed
 # synthetic graph, with hard gates (warm-cache p50 < cold-cache p50,

@@ -14,6 +14,7 @@ import dataclasses
 
 from .determinism import DeterminismPass
 from .locks import LockDisciplinePass
+from .partition import PartitionOwnershipPass
 from .recompile import RecompileSafetyPass
 from .telemetry import TelemetryPass
 from .tuning_constants import TuningConstantsPass
@@ -35,6 +36,7 @@ ALL_PASSES = (
     WireContractPass(),
     TelemetryPass(),
     TuningConstantsPass(),
+    PartitionOwnershipPass(),
 )
 
 RULES: dict[str, RuleDoc] = {}

@@ -1,0 +1,155 @@
+"""Slice-aware half-chain factor build for partitioned serving.
+
+One partition worker's arithmetic state is a *row slice* of the dense
+half-chain factor ``C`` plus its slice of the denominator vector — the
+same two arrays the single-host index build reads
+(:func:`~..index.build.half_chain_and_denominators`), restricted to the
+rows the partition holds. The fold itself reuses the sparse machinery
+(``ops.sparse.half_chain_coo``) over the partition's *sliced* HIN:
+axis-type blocks carry only held rows' edges, so the fold touches only
+held work and its COO output has support exclusively on held rows — the
+slice is free, not a post-hoc filter.
+
+Denominators need one global exchange: for the rowsum variant,
+``d = C · g`` with ``g = Σ_rows C`` summed over EVERY partition's rows.
+Each holder computes the column-sum contribution of each range it holds
+(exact integer sums, so contributions from different holders of the
+same range are bit-identical and the router may take any one); the
+router sums one contribution per range and broadcasts ``g`` back
+(DESIGN.md §26). Until ``g`` arrives a partition cannot score anything.
+
+The factor-slice attributes built here (``c_held`` / ``held_slot_of`` /
+``range_slots``) form the surface the PT001 analyzer pass guards: only
+the partition exchange layer may touch them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from ..data.partition import PartitionMap
+
+# The factor-slice surface the PT001 analyzer pass guards: attribute
+# names that expose raw held-row factor state. Only the partition
+# exchange layer (this module + serving/partition.py) may touch them —
+# any other package code reading them is reading factor rows it does
+# not own, which is exactly the coupling that would silently break the
+# ownership contract. (Registry style mirrors PROTOCOL_OPS/WC001: the
+# analyzer parses this literal, so the rule and the code can't drift.)
+FACTOR_SURFACE = frozenset({"c_held", "held_slot_of", "range_slots"})
+
+
+@dataclasses.dataclass
+class FactorSlice:
+    """The held rows' dense factor slice and its row bookkeeping.
+
+    ``c_held`` is f64 [n_held, V] (exact integer counts, V = padded
+    target width of the half chain); ``rows`` the global row ids of the
+    slots in order; ``held_slot_of`` the inverse map (−1 = not held);
+    ``range_slots`` maps each held range index to its [lo, hi) slot
+    window inside ``c_held``.
+    """
+
+    c_held: np.ndarray
+    rows: np.ndarray
+    held_slot_of: np.ndarray
+    range_slots: dict[int, tuple[int, int]]
+
+    @property
+    def v(self) -> int:
+        return int(self.c_held.shape[1])
+
+    @property
+    def n_held(self) -> int:
+        return int(self.c_held.shape[0])
+
+    def holds(self, row: int) -> bool:
+        return 0 <= row < self.held_slot_of.shape[0] and self.held_slot_of[row] >= 0
+
+
+def build_factor_slice(
+    hin_slice, metapath, pmap: PartitionMap, held: tuple[int, ...]
+) -> FactorSlice:
+    """Fold the (sliced) HIN's half chain and densify only the held
+    rows. ``hin_slice`` must be the output of
+    :func:`~..data.partition.slice_hin` for exactly ``held`` — the fold
+    produces no support outside the held ranges, which is asserted, not
+    assumed."""
+    from ..ops import sparse as sp
+
+    coo = sp.half_chain_coo(hin_slice, metapath).summed()
+    rows_list = []
+    range_slots: dict[int, tuple[int, int]] = {}
+    at = 0
+    for g in held:
+        lo, hi = pmap.range_of(g)
+        rows_list.append(np.arange(lo, hi, dtype=np.int64))
+        range_slots[g] = (at, at + (hi - lo))
+        at += hi - lo
+    rows = (
+        np.concatenate(rows_list) if rows_list
+        else np.empty(0, dtype=np.int64)
+    )
+    held_slot_of = np.full(pmap.n, -1, dtype=np.int64)
+    held_slot_of[rows] = np.arange(rows.shape[0], dtype=np.int64)
+    c_held = np.zeros((rows.shape[0], coo.shape[1]), dtype=np.float64)
+    if coo.rows.shape[0]:
+        src = coo.rows.astype(np.int64)
+        in_logical = src < pmap.n  # capacity-padded slots carry no rows
+        src, cols, w = src[in_logical], coo.cols[in_logical], (
+            coo.weights[in_logical]
+        )
+        slots = held_slot_of[src]
+        if (slots < 0).any():
+            raise ValueError(
+                "sliced half chain has support outside the held ranges "
+                "— slice_hin and build_factor_slice disagree on the axis"
+            )
+        c_held[slots, cols] = w
+    return FactorSlice(
+        c_held=c_held, rows=rows, held_slot_of=held_slot_of,
+        range_slots=range_slots,
+    )
+
+
+def range_colsums(
+    fs: FactorSlice, held: tuple[int, ...]
+) -> dict[int, dict]:
+    """Per-held-range column-sum contributions as sparse wire payloads
+    ``{range: {"cols": [...], "vals": [...]}}`` — exact integer sums,
+    so any holder's contribution for a range equals any other's."""
+    out = {}
+    for g in held:
+        lo, hi = fs.range_slots[g]
+        colsum = fs.c_held[lo:hi].sum(axis=0)
+        nz = np.flatnonzero(colsum)
+        out[g] = {
+            "cols": [int(c) for c in nz],
+            "vals": [float(colsum[c]) for c in nz],
+        }
+    return out
+
+
+def patch_factor_slice(fs: FactorSlice, delta_c, n_logical: int) -> np.ndarray:
+    """Apply a signed half-chain delta (``ops.sparse.COOMatrix``,
+    support restricted to held rows) to the dense slice in place.
+    Returns the sorted global rows whose factor row changed — the rows
+    whose denominators must be recomputed against the new global
+    colsum."""
+    if delta_c.rows.shape[0] == 0:
+        return np.empty(0, dtype=np.int64)
+    src = delta_c.rows.astype(np.int64)
+    in_logical = src < n_logical
+    src = src[in_logical]
+    cols = delta_c.cols[in_logical]
+    w = delta_c.weights[in_logical]
+    slots = fs.held_slot_of[src]
+    if (slots < 0).any():
+        raise ValueError(
+            "half-chain delta touches rows this partition does not hold "
+            "— the router's delta filter and the slice disagree"
+        )
+    np.add.at(fs.c_held, (slots, cols), w.astype(np.float64))
+    return np.unique(src)

@@ -31,12 +31,15 @@ requests (``flight_dump`` op + SIGTERM drain dump).
 
 from .core import Router, RouterConfig, RouterShed
 from .hashring import HashRing, RangeRouter, make_policy
+from .partition import PartitionRouter, PartitionRouterConfig
 from .transport import InprocTransport, SubprocessTransport, WorkerGone
 from .worker import WorkerRuntime, worker_loop
 
 __all__ = [
     "HashRing",
     "InprocTransport",
+    "PartitionRouter",
+    "PartitionRouterConfig",
     "RangeRouter",
     "Router",
     "RouterConfig",

@@ -71,7 +71,65 @@ def build_worker_parser() -> argparse.ArgumentParser:
     p.add_argument("--worker-id", default="w0",
                    help="replica identity (routing, events, heartbeats); "
                    "must not contain ':'")
+    # partition mode (DESIGN.md §26): this worker holds only a row-range
+    # slice of the half-chain factor and serves the partition exchange
+    # ops instead of whole queries
+    p.add_argument("--partition-index", type=int, default=None,
+                   help="partition index this worker owns (enables "
+                   "partition mode; requires --partitions)")
+    p.add_argument("--partitions", type=int, default=None,
+                   help="total partition count of the fleet")
+    p.add_argument("--partition-replication", type=int, default=2,
+                   help="chained replication factor: this worker also "
+                   "mirrors the next R-1 partitions' ranges")
     return p
+
+
+def _build_worker_hin(args):
+    """Dataset spec → the FULL encoded HIN (partition workers
+    fingerprint it whole before slicing, so every partition of the
+    same spec agrees on the base graph)."""
+    from ..data.delta import with_headroom
+
+    if args.dataset.startswith("synthetic:"):
+        from ..data.synthetic import synthetic_hin
+
+        hin = synthetic_hin(**_parse_synthetic(args.dataset))
+    else:
+        from ..engine import load_dataset
+
+        hin = load_dataset(
+            args.dataset,
+            use_native={"auto": None, "python": False,
+                        "native": True}[args.loader],
+        )
+    if args.headroom:
+        hin = with_headroom(hin, args.headroom)
+    return hin
+
+
+def _build_partition_service(args):
+    """Partition-flag args → PartitionService holding only its slice
+    (the full HIN is fingerprinted, sliced, and dropped)."""
+    from ..ops.metapath import compile_metapath
+    from ..serving.partition import PartitionConfig, PartitionService
+
+    if args.partitions is None or args.partitions < 1:
+        raise ValueError("--partition-index requires --partitions >= 1")
+    if not 0 <= args.partition_index < args.partitions:
+        raise ValueError(
+            f"--partition-index {args.partition_index} out of range "
+            f"[0, {args.partitions})"
+        )
+    hin = _build_worker_hin(args)
+    metapath = compile_metapath(args.metapath, hin.schema)
+    return PartitionService(
+        hin, metapath,
+        part_index=args.partition_index,
+        n_parts=args.partitions,
+        replication=args.partition_replication,
+        config=PartitionConfig(variant=args.variant, k_default=args.k),
+    )
 
 
 def _build_worker_service(args):
@@ -102,14 +160,13 @@ def _build_worker_service(args):
     )
     if args.dataset.startswith("synthetic:"):
         from ..backends.base import create_backend
-        from ..data.delta import with_headroom
-        from ..data.synthetic import synthetic_hin
         from ..ops.metapath import compile_metapath
         from ..serving.service import PathSimService
 
-        hin = synthetic_hin(**_parse_synthetic(args.dataset))
-        if args.headroom:
-            hin = with_headroom(hin, args.headroom)
+        # ONE spec-to-HIN path shared with partition workers: replica
+        # and partition builds of the same --dataset must produce the
+        # same base graph (the router's base_fp startup check)
+        hin = _build_worker_hin(args)
         metapath = compile_metapath(args.metapath, hin.schema)
         return PathSimService(
             create_backend(args.backend, hin, metapath),
@@ -164,7 +221,10 @@ def worker_main(argv: list[str] | None = None) -> int:
     installed = preemption_handler.install()
     service = None
     try:
-        service = _build_worker_service(args)
+        if args.partition_index is not None:
+            service = _build_partition_service(args)
+        else:
+            service = _build_worker_service(args)
         if exporter is not None:
             exporter.start()
         runtime = WorkerRuntime(service, worker_id=args.worker_id)
@@ -227,7 +287,19 @@ def build_router_parser() -> argparse.ArgumentParser:
         "hedging, and delta fencing"
     )
     p.add_argument("--workers", type=int, default=2,
-                   help="worker replica count")
+                   help="worker replica count (replicate mode) / "
+                   "partition count (partition mode)")
+    p.add_argument("--mode", default="replicate",
+                   choices=("replicate", "partition"),
+                   help="replicate: N full copies of the graph; "
+                   "partition: ONE graph row-sharded across N workers "
+                   "with distributed half-chain multiply and exact "
+                   "global top-k merge (DESIGN.md §26)")
+    p.add_argument("--replication", type=int, default=2,
+                   help="partition mode: chained replication factor "
+                   "(each worker mirrors the next R-1 partitions' "
+                   "ranges; R>=2 survives worker death with zero lost "
+                   "requests)")
     p.add_argument("--routing", default="hash", choices=("hash", "range"),
                    help="replica selection: consistent-hash-by-row "
                    "(cache affinity) or contiguous row ranges")
@@ -266,9 +338,13 @@ def build_router_parser() -> argparse.ArgumentParser:
     return p
 
 
-def _worker_argv(args, index: int) -> list[str]:
+def _worker_argv(args, index: int, partition: bool = False) -> list[str]:
     argv = [sys.executable, "-m", "distributed_pathsim_tpu.cli", "worker",
             "--worker-id", f"w{index}"]
+    if partition:
+        argv += ["--partition-index", str(index),
+                 "--partitions", str(args.workers),
+                 "--partition-replication", str(args.replication)]
     for name in _FORWARD_VALUE:
         val = getattr(args, name)
         if val is None:
@@ -357,25 +433,47 @@ def router_main(argv: list[str] | None = None) -> int:
                        metrics_path=args.metrics)
     set_event_sink(logger)
     installed = preemption_handler.install()
+    partition_mode = args.mode == "partition"
     transports = {
-        f"w{i}": SubprocessTransport(f"w{i}", _worker_argv(args, i))
+        f"w{i}": SubprocessTransport(
+            f"w{i}", _worker_argv(args, i, partition=partition_mode)
+        )
         for i in range(args.workers)
     }
-    router = Router(
-        transports,
-        RouterConfig(
-            routing=args.routing,
-            hedge_ms=args.hedge_ms or None,
-            heartbeat_interval_s=args.heartbeat_interval,
-            heartbeat_miss_limit=args.heartbeat_miss,
-            max_inflight=args.max_inflight,
-            default_deadline_ms=args.deadline_ms,
-            scrape_interval_s=args.scrape_interval,
-            slo_specs=slo_specs,
-            slow_ms=args.slow_ms,
-            flight_capacity=args.flight_capacity,
-        ),
-    )
+    if partition_mode:
+        from .partition import PartitionRouter, PartitionRouterConfig
+
+        router = PartitionRouter(
+            transports,
+            PartitionRouterConfig(
+                partitions=args.workers,
+                replication=args.replication,
+                heartbeat_interval_s=args.heartbeat_interval,
+                heartbeat_miss_limit=args.heartbeat_miss,
+                max_inflight=args.max_inflight,
+                default_deadline_ms=args.deadline_ms,
+                scrape_interval_s=args.scrape_interval,
+                slo_specs=slo_specs,
+                slow_ms=args.slow_ms,
+                flight_capacity=args.flight_capacity,
+            ),
+        )
+    else:
+        router = Router(
+            transports,
+            RouterConfig(
+                routing=args.routing,
+                hedge_ms=args.hedge_ms or None,
+                heartbeat_interval_s=args.heartbeat_interval,
+                heartbeat_miss_limit=args.heartbeat_miss,
+                max_inflight=args.max_inflight,
+                default_deadline_ms=args.deadline_ms,
+                scrape_interval_s=args.scrape_interval,
+                slo_specs=slo_specs,
+                slow_ms=args.slow_ms,
+                flight_capacity=args.flight_capacity,
+            ),
+        )
     # drain-time artifacts: written by Router.drain() while the
     # workers can still answer the final span-ring scrape
     router.flight_out = args.flight_out

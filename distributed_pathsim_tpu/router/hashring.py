@@ -29,6 +29,8 @@ from __future__ import annotations
 import bisect
 import hashlib
 
+from ..data.partition import PartitionMap
+
 
 def _h64(s: str) -> int:
     """Stable 64-bit point on the ring for a key string."""
@@ -85,6 +87,11 @@ class HashRing:
     def owner(self, key: int | str) -> str:
         return self.preference(key)[0]
 
+    def owner_of(self, row: int | str) -> str:
+        """Alias of :meth:`owner` — the stable ownership API both
+        policies export (range mode adds :meth:`range_of`)."""
+        return self.owner(row)
+
     def without(self, worker_id: str) -> "HashRing":
         """The ring minus one member (worker death): every key that
         worker owned moves to its ring successor; every other key keeps
@@ -96,18 +103,28 @@ class HashRing:
 class RangeRouter:
     """Contiguous row-range ownership over ``n_rows``.
 
-    Worker ``i`` of W owns rows ``[i*ceil(n/W), (i+1)*ceil(n/W))``.
-    Preference order is owner, then neighbors outward (the replicas
-    most likely to have adjacent rows warm). Non-integer keys (label
-    queries) fall back to a stable hash into the row space, so the
-    interface stays total."""
+    Worker ``i`` of W owns rows ``[i*ceil(n/W), (i+1)*ceil(n/W))`` —
+    the ceil-division geometry shared with
+    :class:`~..data.partition.PartitionMap`, so routing and *ownership*
+    (partition mode, where a worker only HOLDS its ranges) can never
+    disagree. Preference order is owner, then neighbors outward (the
+    replicas most likely to have adjacent rows warm). Non-integer keys
+    (label queries) fall back to a stable hash into the row space, so
+    the interface stays total.
+
+    The stable ownership API — :meth:`owner_of` (row → worker id,
+    strict on the row domain) and :meth:`range_of` (worker id →
+    half-open row range) — is what the partitioned fleet builds on;
+    the boundary-row property tests in tests/test_partition.py pin it.
+    """
 
     def __init__(self, worker_ids: list[str], n_rows: int):
         if not worker_ids:
             raise ValueError("range router needs at least one worker")
         self._workers = sorted(worker_ids)
         self.n_rows = max(int(n_rows), 1)
-        self._span = -(-self.n_rows // len(self._workers))  # ceil div
+        self._pmap = PartitionMap(n=self.n_rows, p=len(self._workers))
+        self._span = self._pmap.span
 
     @property
     def workers(self) -> tuple[str, ...]:
@@ -128,6 +145,25 @@ class RangeRouter:
 
     def owner(self, key: int | str) -> str:
         return self.preference(key)[0]
+
+    def owner_of(self, row: int) -> str:
+        """Worker id owning ``row`` — strict on ``[0, n_rows)`` (an
+        out-of-range row is a caller bug, not a routing choice; the
+        forgiving clamp lives in :meth:`preference` for label keys)."""
+        return self._workers[self._pmap.owner_of(int(row))]
+
+    def range_of(self, worker_id: str) -> tuple[int, int]:
+        """Half-open row range ``[lo, hi)`` this worker owns. The last
+        worker absorbs the ceil-division remainder; with a single
+        worker the range is the whole row space."""
+        try:
+            i = self._workers.index(worker_id)
+        except ValueError:
+            raise KeyError(
+                f"unknown worker {worker_id!r} "
+                f"(members: {self._workers})"
+            ) from None
+        return self._pmap.range_of(i)
 
     def without(self, worker_id: str) -> "RangeRouter":
         rest = [w for w in self._workers if w != worker_id]

@@ -51,8 +51,12 @@ from ..serving.service import PathSimService
 from ..utils.logging import runtime_event
 
 # ops whose effect must apply exactly once across retries — everything
-# else is a deterministic read, safe to repeat anywhere
-MUTATING_OPS = frozenset({"update", "invalidate"})
+# else is a deterministic read, safe to repeat anywhere. The partition
+# pair (part_update / set_colsum) is what makes routed-delta catch-up
+# replays idempotent: a re-delivered phase replays its cached ack.
+MUTATING_OPS = frozenset({
+    "update", "invalidate", "part_update", "set_colsum",
+})
 
 _DEDUP_CAPACITY = 1024
 

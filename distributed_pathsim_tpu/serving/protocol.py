@@ -114,6 +114,13 @@ _QUERY_KEYS = ("source", "source_id", "row")
 PROTOCOL_OPS = frozenset({
     "ping", "stats", "metrics", "health", "invalidate", "topk",
     "refresh_index", "update", "scores", "trace",
+    # partition-mode exchange ops (DESIGN.md §26): served by
+    # PartitionService workers behind `dpathsim router --mode
+    # partition`; on a replica service they fail as clean per-request
+    # errors. part_update/set_colsum are MUTATING_OPS in the worker
+    # runtime, so routed-delta retries dedup by request_id.
+    "resolve", "part_info", "set_colsum", "tile_pull", "partial_topk",
+    "partial_scores", "part_update",
 })
 
 # op → (latency-histogram cell, error-counter cell), bound on first use
@@ -248,7 +255,42 @@ def _dispatch_op(
             row=req.get("row"),
         )
         return {"row": row, "scores": service.scores_index(row).tolist()}
+    if op == "resolve":
+        # label/id → global dense row; any worker answers (partition
+        # workers keep FULL index spaces — only edges are sliced)
+        return {
+            "row": service.resolve(
+                source=req.get("source"),
+                source_id=req.get("source_id"),
+                row=req.get("row"),
+            )
+        }
+    if op == "part_info":
+        return _partition_op(service, "part_info", req)
+    if op == "set_colsum":
+        return _partition_op(service, "set_colsum", req)
+    if op == "tile_pull":
+        return _partition_op(service, "tile_pull", req)
+    if op == "partial_topk":
+        return _partition_op(service, "partial_topk", req)
+    if op == "partial_scores":
+        return _partition_op(service, "partial_scores", req)
+    if op == "part_update":
+        return _partition_op(service, "part_update", req)
     raise KeyError(f"unknown op {op!r}")
+
+
+def _partition_op(service, op: str, req: dict):
+    """Partition-exchange dispatch: clean per-request error on a
+    replica service (the op vocabulary is shared; the capability is
+    not)."""
+    handler = getattr(service, op, None)
+    if handler is None:
+        raise KeyError(
+            f"op {op!r} requires a partition worker "
+            "(dpathsim worker --partition-index ...)"
+        )
+    return handler(req)
 
 
 def handle_request(service: PathSimService, req: dict) -> dict:
@@ -288,6 +330,10 @@ def handle_request(service: PathSimService, req: dict) -> dict:
         error_cell.inc()
         msg = exc.args[0] if exc.args else repr(exc)
         resp = {"id": rid, "ok": False, "error": str(msg)}
+        if getattr(exc, "transient", False):
+            # e.g. a partition worker mid colsum-exchange: the router
+            # should retry/fence, not surface a hard failure
+            resp["transient"] = True
         if isinstance(exc, DeadlineExceeded) or (
             deadline is not None and deadline.expired
         ):

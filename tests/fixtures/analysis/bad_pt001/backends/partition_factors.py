@@ -1,0 +1,8 @@
+"""Fixture: the guarded factor-slice surface registry."""
+FACTOR_SURFACE = frozenset({"c_held", "held_slot_of", "range_slots"})
+
+
+class FactorSlice:
+    def __init__(self, c_held, held_slot_of):
+        self.c_held = c_held
+        self.held_slot_of = held_slot_of

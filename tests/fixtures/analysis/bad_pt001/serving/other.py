@@ -1,0 +1,5 @@
+"""BAD: reads the factor slice outside the exchange layer (PT001)."""
+
+
+def peek_foreign_rows(fs, row):
+    return fs.c_held[row]

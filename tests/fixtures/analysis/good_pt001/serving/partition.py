@@ -1,0 +1,5 @@
+"""GOOD: the exchange layer itself may touch the factor slice."""
+
+
+def tile_for(fs, row):
+    return fs.c_held[int(fs.held_slot_of[row])]

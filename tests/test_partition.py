@@ -1,0 +1,485 @@
+"""Graph-partitioned serving: ownership, exchange, exact merge, chaos.
+
+The load-bearing guarantees (ISSUE 11 / DESIGN.md §26):
+
+- the ownership geometry is stable and total: ``owner_of``/``range_of``
+  agree with routing at every range boundary, the single-worker case
+  degenerates to "owns everything", and ranges tile [0, n) exactly;
+- a partition worker's factor slice is bit-identical to the
+  corresponding rows of the full half-chain factor;
+- scatter-gather answers (top-k AND full score rows) are bit-identical
+  to a single-host oracle — across random partition counts, random
+  delta sequences, and tie-heavy graphs — because every merge input is
+  an exact integer and selection runs through the shared ops/pathsim
+  primitives at every hop;
+- a routed delta is O(Δ) at the owners, sealed by the two-phase colsum
+  exchange, and a partition that misses a phase is fenced and caught
+  up by ordered idempotent replay;
+- a worker SIGKILLed mid-batch loses nothing: chained replication
+  keeps every range servable and sub-requests re-dispatch.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+from distributed_pathsim_tpu.backends.base import create_backend
+from distributed_pathsim_tpu.backends.partition_factors import (
+    build_factor_slice,
+)
+from distributed_pathsim_tpu.data.delta import delta_from_records
+from distributed_pathsim_tpu.data.partition import PartitionMap, slice_hin
+from distributed_pathsim_tpu.data.synthetic import synthetic_hin
+from distributed_pathsim_tpu.ops import sparse as sp
+from distributed_pathsim_tpu.ops.metapath import compile_metapath
+from distributed_pathsim_tpu.resilience import inject
+from distributed_pathsim_tpu.router import (
+    HashRing,
+    InprocTransport,
+    PartitionRouter,
+    PartitionRouterConfig,
+    RangeRouter,
+    WorkerRuntime,
+)
+from distributed_pathsim_tpu.serving import PathSimService, ServeConfig
+from distributed_pathsim_tpu.serving.partition import PartitionService
+from distributed_pathsim_tpu.serving.protocol import handle_request
+
+
+@pytest.fixture(scope="module")
+def hin():
+    return synthetic_hin(140, 230, 8, seed=11)
+
+
+@pytest.fixture(scope="module")
+def metapath(hin):
+    return compile_metapath("APVPA", hin.schema)
+
+
+def _oracle(hin, metapath):
+    return PathSimService(
+        create_backend("numpy", hin, metapath),
+        config=ServeConfig(
+            warm=False, max_wait_ms=0.5, delta_threshold=1.0
+        ),
+    )
+
+
+def _oracle_topk(oracle, row: int, k: int):
+    vals, idxs = oracle.topk_index(int(row), k)
+    return [
+        (oracle._ident(int(j))[0], float(v))
+        for v, j in zip(vals, idxs)
+        if np.isfinite(v)
+    ]
+
+
+def _got_topk(resp: dict):
+    assert resp.get("ok"), resp
+    return [(h["id"], h["score"]) for h in resp["result"]["topk"]]
+
+
+# -- ownership geometry: owner_of / range_of boundary properties -----------
+
+
+def test_range_router_owner_api_boundaries():
+    """Satellite 2: first/last row of every range route to that range's
+    worker; the ranges tile [0, n) exactly; owner_of agrees with
+    preference()[0] everywhere (ownership IS routing)."""
+    rng = np.random.default_rng(3)
+    for n, w in [(1, 1), (7, 3), (97, 4), (100, 100), (5, 9), (64, 2)]:
+        workers = [f"w{i}" for i in range(w)]
+        rr = RangeRouter(workers, n_rows=n)
+        covered = []
+        for wid in rr.workers:
+            lo, hi = rr.range_of(wid)
+            assert 0 <= lo <= hi <= n
+            covered.extend(range(lo, hi))
+            if lo < hi:  # boundary rows: first and last of the range
+                assert rr.owner_of(lo) == wid
+                assert rr.owner_of(hi - 1) == wid
+                assert rr.preference(lo)[0] == wid
+                assert rr.preference(hi - 1)[0] == wid
+        # the ranges tile the row space exactly once
+        assert covered == list(range(n))
+        for row in rng.integers(0, n, size=16):
+            assert rr.owner_of(int(row)) == rr.preference(int(row))[0]
+    # degenerate single worker: owns everything
+    rr1 = RangeRouter(["only"], n_rows=41)
+    assert rr1.range_of("only") == (0, 41)
+    assert rr1.owner_of(0) == "only" and rr1.owner_of(40) == "only"
+    with pytest.raises(ValueError):
+        rr1.owner_of(41)
+    with pytest.raises(KeyError):
+        rr1.range_of("ghost")
+
+
+def test_hashring_owner_of_alias():
+    ring = HashRing(["a", "b", "c"], vnodes=32)
+    for key in (0, 1, 17, "label"):
+        assert ring.owner_of(key) == ring.preference(key)[0]
+
+
+def test_partition_map_holders_and_replication():
+    pm = PartitionMap(n=100, p=4)
+    # chained replication: worker i holds i, i+1 (mod p)
+    assert pm.held_by(0, 2) == (0, 1)
+    assert pm.held_by(3, 2) == (3, 0)
+    # holders of range g: owner first, then the chained mirrors
+    assert pm.holders_of(0, 2) == (0, 3)
+    assert pm.holders_of(2, 3) == (2, 1, 0)
+    # replication clamps to p; every range held by every worker then
+    assert set(pm.held_by(1, 99)) == {0, 1, 2, 3}
+    # empty tail ranges when n < p
+    pm_small = PartitionMap(n=3, p=5)
+    spans = [pm_small.range_of(g) for g in range(5)]
+    assert sum(hi - lo for lo, hi in spans) == 3
+
+
+# -- the factor slice is exactly the full factor's rows --------------------
+
+
+def test_factor_slice_matches_full_factor(hin, metapath):
+    full = sp.dense_half_chain(hin, metapath).astype(np.float64)
+    pm = PartitionMap(n=hin.type_size("author"), p=3)
+    for part in range(3):
+        held = pm.held_by(part, 2)
+        sliced = slice_hin(
+            hin, "author", [pm.range_of(g) for g in held]
+        )
+        fs = build_factor_slice(sliced, metapath, pm, held)
+        assert np.array_equal(fs.c_held, full[fs.rows])
+        # the inverse map round-trips
+        for row in fs.rows[:: max(len(fs.rows) // 7, 1)]:
+            assert fs.rows[fs.held_slot_of[row]] == row
+
+
+# -- inproc partition fleet helpers ----------------------------------------
+
+
+class _PartFleet:
+    """P inproc partition workers + a PartitionRouter, one unit."""
+
+    def __init__(self, hin, metapath, partitions: int,
+                 replication: int = 2, **router_cfg):
+        self.transports = {}
+        self.services = []
+        for i in range(partitions):
+            svc = PartitionService(
+                hin, metapath, i, partitions, replication
+            )
+            self.services.append(svc)
+            self.transports[f"w{i}"] = InprocTransport(
+                f"w{i}", WorkerRuntime(svc, worker_id=f"w{i}")
+            )
+        router_cfg.setdefault("heartbeat_interval_s", 0.05)
+        self.router = PartitionRouter(
+            self.transports,
+            PartitionRouterConfig(
+                partitions=partitions, replication=replication,
+                **router_cfg,
+            ),
+        )
+        self.router.start()
+
+    def close(self):
+        self.router.close()
+
+
+def _random_edge_delta(oracle, rng, n_papers: int):
+    """Random edge adds/removes on both the axis block (author_of) and
+    the shared block (submit_at) — the two delta shapes partition mode
+    routes differently."""
+    cur = oracle.hin.blocks["author_of"]
+    j = int(rng.integers(0, cur.rows.shape[0]))
+    removes = [{"rel": "author_of", "src_row": int(cur.rows[j]),
+                "dst_row": int(cur.cols[j])}]
+    existing = set(zip(cur.rows.tolist(), cur.cols.tolist()))
+    adds = []
+    while len(adds) < 2:
+        a = int(rng.integers(0, oracle.n))
+        p = int(rng.integers(0, n_papers))
+        if (a, p) not in existing and not any(
+            x["src_row"] == a and x["dst_row"] == p for x in adds
+        ):
+            adds.append({"rel": "author_of", "src_row": a, "dst_row": p})
+    pv = oracle.hin.blocks["submit_at"]
+    nv = int(pv.cols.max()) + 1
+    if nv > 1:
+        j = int(rng.integers(0, pv.rows.shape[0]))
+        old_v = int(pv.cols[j])
+        removes.append({"rel": "submit_at",
+                        "src_row": int(pv.rows[j]), "dst_row": old_v})
+        adds.append({"rel": "submit_at", "src_row": int(pv.rows[j]),
+                     "dst_row": (old_v + 1) % nv})
+    return adds, removes
+
+
+# -- the headline property: random fleets × random deltas, bit-exact ------
+
+
+def test_partition_oracle_parity_property():
+    """Satellite 3: random partitioned fleets (2–5 partitions) ×
+    random delta sequences — every topk AND scores answer bit-identical
+    to a single-host oracle absorbing the same deltas, ties included
+    (tiny venue count ⇒ massive score-tie plateaus, so the
+    (−score, ascending col) order is genuinely exercised)."""
+    rng = np.random.default_rng(29)
+    for p_count in (2, 4, 5):
+        # few venues → many identical score values → tie-order stress
+        hin = synthetic_hin(
+            50 + int(rng.integers(0, 40)), 90, 3,
+            seed=int(rng.integers(0, 1000)),
+        )
+        mp = compile_metapath("APVPA", hin.schema)
+        oracle = _oracle(hin, mp)
+        fleet = _PartFleet(hin, mp, p_count, replication=2)
+        try:
+            for _delta_round in range(3):
+                for row in rng.integers(0, oracle.n, size=6):
+                    row = int(row)
+                    r = fleet.router.request(
+                        {"id": 1, "op": "topk", "row": row, "k": 8},
+                        timeout=30,
+                    )
+                    assert _got_topk(r) == _oracle_topk(oracle, row, 8)
+                row = int(rng.integers(0, oracle.n))
+                r = fleet.router.request(
+                    {"id": 2, "op": "scores", "row": row}, timeout=30
+                )
+                assert r["ok"]
+                assert r["result"]["scores"] == (
+                    oracle.scores_index(row).tolist()
+                )
+                adds, removes = _random_edge_delta(oracle, rng, 90)
+                resp = fleet.router.request(
+                    {"id": 3, "op": "update", "add_edges": adds,
+                     "remove_edges": removes},
+                    timeout=30,
+                )
+                assert resp["ok"], resp
+                # under an ambient chaos plan a worker may miss a
+                # phase: it is fenced (answers stay exact regardless);
+                # wait out catch-up so the next round starts converged
+                if resp["result"]["lagging"]:
+                    deadline = time.monotonic() + 10
+                    while time.monotonic() < deadline:
+                        workers = fleet.router.stats()["router"]["workers"]
+                        if all(w["lag"] == 0 for w in workers.values()
+                               if w["status"] != "down"):
+                            break
+                        time.sleep(0.02)
+                oracle.update(delta_from_records(
+                    oracle.hin, add_edges=adds, remove_edges=removes
+                ))
+        finally:
+            fleet.close()
+            oracle.close()
+
+
+def test_partition_rejects_node_appends(hin, metapath):
+    fleet = _PartFleet(hin, metapath, 2)
+    try:
+        resp = fleet.router.request(
+            {"id": 1, "op": "update",
+             "add_nodes": [{"type": "author", "id": "a_new"}]},
+            timeout=30,
+        )
+        assert not resp["ok"]
+        assert "edge deltas only" in resp["error"]
+    finally:
+        fleet.close()
+
+
+# -- fencing: a partition that misses a phase is fenced, then caught up ----
+
+
+def test_partition_missed_broadcast_fences_then_catches_up(hin, metapath):
+    oracle = _oracle(hin, metapath)
+    # drop the FIRST broadcast send (w0's part_update): w0 lags the
+    # head and must be fenced out of every scatter until catch-up
+    inject.install_plan("delta_broadcast:error:1")
+    fleet = _PartFleet(hin, metapath, 3, replication=2)
+    router = fleet.router
+    try:
+        adds = [{"rel": "author_of", "src_row": 5, "dst_row": 11}]
+        resp = router.request(
+            {"id": 1, "op": "update", "add_edges": adds}, timeout=30
+        )
+        assert resp["ok"], resp
+        assert resp["result"]["lagging"] == ["w0"]
+        oracle.update(delta_from_records(oracle.hin, add_edges=adds))
+        # every answer is still oracle-exact: w0 is fenced, its ranges
+        # answered by the chained mirrors
+        for row in (0, 5, 70, 139):
+            r = router.request(
+                {"id": 2, "op": "topk", "row": row, "k": 5}, timeout=30
+            )
+            assert _got_topk(r) == _oracle_topk(oracle, row, 5)
+        # catch-up: pongs show the lag, the router replays both phases
+        # (idempotent by request_id), the lag clears
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            st = router.stats()["router"]["workers"]["w0"]
+            if st["lag"] == 0:
+                break
+            time.sleep(0.02)
+        else:
+            raise AssertionError("w0 never caught up")
+        for row in (0, 5, 139):
+            r = router.request(
+                {"id": 3, "op": "topk", "row": row, "k": 5}, timeout=30
+            )
+            assert _got_topk(r) == _oracle_topk(oracle, row, 5)
+    finally:
+        inject.reset()
+        fleet.close()
+        oracle.close()
+
+
+# -- chaos: partition-kill mid-batch (make chaos-router picks this up) -----
+
+
+@pytest.mark.chaos
+def test_partition_router_kill_mid_batch_zero_lost(hin, metapath):
+    """Satellite 3: partition-kill mid-batch → zero lost requests.
+    Chained replication (R=2) keeps every range servable; orphaned
+    sub-requests re-dispatch to the surviving holders and the answers
+    stay bit-identical."""
+    oracle = _oracle(hin, metapath)
+    fleet = _PartFleet(hin, metapath, 3, replication=2)
+    router = fleet.router
+    try:
+        futs = [
+            router.submit({"id": i, "op": "topk",
+                           "row": int(i % oracle.n), "k": 5})
+            for i in range(40)
+        ]
+        fleet.transports["w1"].kill()  # mid-batch, no goodbye
+        resps = [f.result(timeout=30) for f in futs]
+        assert all(r["ok"] for r in resps), [
+            r for r in resps if not r["ok"]
+        ][:3]
+        # post-kill: every range still answers, oracle-exact
+        for row in (0, 60, 100, 139):
+            r = router.request(
+                {"id": 9, "op": "topk", "row": row, "k": 5}, timeout=30
+            )
+            assert _got_topk(r) == _oracle_topk(oracle, row, 5)
+        assert (
+            router.stats()["router"]["workers"]["w1"]["status"] == "down"
+        )
+    finally:
+        fleet.close()
+        oracle.close()
+
+
+@pytest.mark.chaos
+def test_partition_router_update_with_dead_worker(hin, metapath):
+    """A routed delta with a dead holder: the update seals on the
+    survivors, answers stay exact (the dead worker's ranges are served
+    by mirrors at the new epoch)."""
+    oracle = _oracle(hin, metapath)
+    fleet = _PartFleet(hin, metapath, 3, replication=2)
+    router = fleet.router
+    try:
+        fleet.transports["w2"].kill()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if router.stats()["router"]["workers"]["w2"]["status"] == (
+                "down"
+            ):
+                break
+            time.sleep(0.01)
+        adds = [{"rel": "author_of", "src_row": 100, "dst_row": 3}]
+        # under an ambient chaos plan the broadcast to the LAST live
+        # holder of a range may be dropped: the update must then ABORT
+        # cleanly (transient, nothing half-applied) rather than seal a
+        # head missing that range's contribution — retry until sealed
+        for _ in range(5):
+            resp = router.request(
+                {"id": 1, "op": "update", "add_edges": adds}, timeout=30
+            )
+            if resp["ok"]:
+                break
+            assert resp.get("transient"), resp
+        assert resp["ok"], resp
+        assert "w2" not in resp["result"]["sealed"]
+        oracle.update(delta_from_records(oracle.hin, add_edges=adds))
+        for row in (100, 0, 139):
+            r = router.request(
+                {"id": 2, "op": "topk", "row": row, "k": 5}, timeout=30
+            )
+            assert _got_topk(r) == _oracle_topk(oracle, row, 5)
+    finally:
+        fleet.close()
+        oracle.close()
+
+
+# -- protocol surface ------------------------------------------------------
+
+
+def test_partition_ops_error_cleanly_on_replica_service(hin, metapath):
+    """The partition op vocabulary is registered protocol-wide; on a
+    replica (non-partition) service each op fails as a clean
+    per-request error that still echoes request_id."""
+    svc = _oracle(hin, metapath)
+    try:
+        for op in ("part_info", "set_colsum", "tile_pull",
+                   "partial_topk", "partial_scores", "part_update"):
+            resp = handle_request(
+                svc, {"id": 1, "op": op, "request_id": f"x-{op}"}
+            )
+            assert not resp["ok"]
+            assert "partition worker" in resp["error"]
+            assert resp["request_id"] == f"x-{op}"
+        # resolve works on ANY service (full index spaces everywhere)
+        resp = handle_request(svc, {"id": 2, "op": "resolve", "row": 7})
+        assert resp["ok"] and resp["result"]["row"] == 7
+    finally:
+        svc.close()
+
+
+def test_partition_worker_not_ready_is_transient(hin, metapath):
+    """Before the colsum exchange a partial op fails TRANSIENT — the
+    signal the router retries/fences on, never a hard client error."""
+    svc = PartitionService(hin, metapath, 0, 2, replication=1)
+    resp = handle_request(
+        svc, {"id": 1, "op": "partial_topk", "range": 0, "row": 1,
+              "k": 3, "cols": [], "vals": [], "d_source": 0.0}
+    )
+    assert not resp["ok"] and resp.get("transient")
+
+
+def test_tile_pull_redirects_off_owner(hin, metapath):
+    """A tile pull for a row outside the held ranges answers with the
+    owner instead of an error — the router re-aims in one hop."""
+    svc = PartitionService(hin, metapath, 0, 3, replication=1)
+    lo, hi = svc.pmap.range_of(2)  # held by w2 only (R=1)
+    resp = svc.tile_pull({"row": lo})
+    assert resp["wrong_owner"] and resp["owner"] == 2
+
+
+# -- the subprocess smoke (make partition-smoke) ---------------------------
+
+
+def test_bench_partition_smoke():
+    """``make partition-smoke`` as a tier-1 test: 3 real partition
+    worker subprocesses, closed-loop load, routed deltas, one mid-load
+    SIGKILL; gates zero lost, zero steady-state recompiles, oracle
+    bit-parity, and the max-N-grows-with-workers curve."""
+    import os
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, repo)
+    try:
+        import bench_serving
+
+        result = bench_serving.run_partition_smoke()
+    finally:
+        sys.path.remove(repo)
+    assert all(result["smoke_checks"].values()), result["smoke_checks"]
