@@ -5,7 +5,7 @@ PYTHON ?= python
 
 .PHONY: test chaos chaos-router serve-smoke update-smoke obs-smoke \
 	router-smoke partition-smoke ann-smoke fleet-obs-smoke lint \
-	lint-telemetry tune-smoke lint-tuning tune
+	lint-schema lint-telemetry tune-smoke lint-tuning tune
 
 # Tier-1: the fast CPU suite (the driver's acceptance gate).
 test:
@@ -104,14 +104,21 @@ obs-smoke:
 fleet-obs-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) bench_serving.py --regime fleet-obs --smoke
 
-# Unified static analysis (analysis/, DESIGN.md §25): recompile-safety,
-# lock-discipline, determinism, and wire-contract passes over the
+# Unified static analysis (analysis/, DESIGN.md §25/§27):
+# recompile-safety, lock-discipline + interprocedural lock-order /
+# blocking-under-lock, determinism, wire-contract + inferred
+# wire-schema compatibility gate, and exception-safety passes over the
 # package + scripts + tests, with one checked-in baseline. Exits
 # nonzero on any non-baselined finding (expired/stale baseline entries
-# included). Also a non-slow pytest
+# included). Writes the SARIF report for CI annotations alongside the
+# human output; `--write-wire-schema` regenerates the checked-in
+# artifacts/wire_schema.json. Also a non-slow pytest
 # (tests/test_analysis.py::test_repo_is_clean), so tier-1 covers it.
 lint:
-	$(PYTHON) -m distributed_pathsim_tpu.cli lint
+	$(PYTHON) -m distributed_pathsim_tpu.cli lint --sarif artifacts/lint.sarif
+
+lint-schema:
+	$(PYTHON) -m distributed_pathsim_tpu.cli lint --write-wire-schema
 
 # DEPRECATED (one release): the telemetry rules migrated into `make
 # lint` (DT003/TL001/TL002/WC001/WC003/WC004); this target execs the

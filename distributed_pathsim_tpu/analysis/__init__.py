@@ -18,12 +18,28 @@ contract this repo's value proposition rests on:
 - **wire contract** (wire.py): every protocol op registered in
   ``PROTOCOL_OPS``, wire-field reads defaulted (old clients keep
   working), stdout of wire-owning processes print-free.
+- **interprocedural lock order** (interlocks.py, on callgraph.py):
+  whole-repo held-locks-at-entry fixpoint, the global
+  lock-acquisition-order graph (LD101 cycles = potential deadlock),
+  blocking calls (LD102) and transport round-trips (LD103) reachable
+  while a lock is held — DESIGN.md §27.
+- **wire-schema gate** (wireschema.py): the per-op request/response
+  field schema inferred by dataflow and checked in as the byte-stable
+  ``artifacts/wire_schema.json``; backward-incompatible drift fails
+  the build (WC101), stale files flag (WC102), dead fields flag
+  (WC103).
+- **exception safety** (exceptions.py): bare acquires (EX001), leaked
+  handles (EX002), and pending-table registrations whose removal an
+  exception can skip (EX003) — exactly-once on every exit path.
 - **telemetry** (telemetry.py) and **tuning constants**
   (tuning_constants.py): the migrated ``scripts/lint_telemetry.py`` /
   ``scripts/lint_tuning.py`` rules, absorbed so there is ONE analyzer.
 
-Run it as ``dpathsim lint`` or ``make lint``; see core.py for the
-Finding model, baseline semantics, and renderers.
+Run it as ``dpathsim lint`` or ``make lint`` (which also writes the
+SARIF report to artifacts/lint.sarif); see core.py for the Finding
+model, baseline semantics, and renderers, cache.py for the parse/mtime
+cache that keeps the whole-repo run inside the tier-1 10 s gate, and
+callgraph.py for the interprocedural engine.
 """
 
 from .core import (  # noqa: F401
@@ -36,4 +52,8 @@ from .core import (  # noqa: F401
     render_json,
     run_analysis,
 )
+from .cache import load_modules_cached  # noqa: F401
+from .callgraph import CallGraph, propagate_reachability  # noqa: F401
 from .registry import ALL_PASSES, MIGRATED_RULES, RULES  # noqa: F401
+from .sarif import render_sarif  # noqa: F401
+from .wireschema import infer_schema, render_schema  # noqa: F401

@@ -13,12 +13,15 @@ from __future__ import annotations
 import dataclasses
 
 from .determinism import DeterminismPass
+from .exceptions import ExceptionSafetyPass
+from .interlocks import InterLockPass
 from .locks import LockDisciplinePass
 from .partition import PartitionOwnershipPass
 from .recompile import RecompileSafetyPass
 from .telemetry import TelemetryPass
 from .tuning_constants import TuningConstantsPass
 from .wire import WireContractPass
+from .wireschema import WireSchemaPass
 
 
 @dataclasses.dataclass(frozen=True)
@@ -29,14 +32,32 @@ class RuleDoc:
     pass_name: str
 
 
+# rule-family display names for the grouped `--list-rules` catalog
+PASS_FAMILIES: dict[str, str] = {
+    "RecompileSafetyPass": "recompile-safety (RS)",
+    "LockDisciplinePass": "lock discipline, intra-class (LD001+)",
+    "InterLockPass": "lock order / blocking-under-lock, "
+                     "interprocedural (LD101+)",
+    "DeterminismPass": "determinism (DT)",
+    "WireContractPass": "wire contract, syntactic (WC001+)",
+    "WireSchemaPass": "wire schema inference + compat gate (WC101+)",
+    "TelemetryPass": "telemetry (TL)",
+    "TuningConstantsPass": "tuning constants (TN)",
+    "PartitionOwnershipPass": "partition ownership (PT)",
+    "ExceptionSafetyPass": "exception safety / exactly-once (EX)",
+}
+
 ALL_PASSES = (
     RecompileSafetyPass(),
     LockDisciplinePass(),
+    InterLockPass(),
     DeterminismPass(),
     WireContractPass(),
+    WireSchemaPass(),
     TelemetryPass(),
     TuningConstantsPass(),
     PartitionOwnershipPass(),
+    ExceptionSafetyPass(),
 )
 
 RULES: dict[str, RuleDoc] = {}

@@ -409,21 +409,27 @@ class PartitionRouter:
                 ]
             col = _Collector([w.wid for w in targets])
             self._collectors[token] = col
-        for w in targets:
-            per = dict(wire)
-            per["id"] = f"cl:{token}:{w.wid}"
-            try:
-                if tag in ("up", "cs"):
-                    # the delta_broadcast chaos seam: an injected error
-                    # means THIS partition misses the phase — it lags
-                    # the head and is fenced until catch-up replay
-                    inject.fire("delta_broadcast")
-                w.transport.send(per)
-            except (inject.InjectedFault, WorkerGone) as exc:
-                col.resolve(w.wid, None, repr(exc))
-        col.wait(timeout)
-        with self._lock:
-            self._collectors.pop(token, None)
+        try:
+            for w in targets:
+                per = dict(wire)
+                per["id"] = f"cl:{token}:{w.wid}"
+                try:
+                    if tag in ("up", "cs"):
+                        # the delta_broadcast chaos seam: an injected
+                        # error means THIS partition misses the phase —
+                        # it lags the head and is fenced until catch-up
+                        # replay
+                        inject.fire("delta_broadcast")
+                    w.transport.send(per)
+                except (inject.InjectedFault, WorkerGone) as exc:
+                    col.resolve(w.wid, None, repr(exc))
+            col.wait(timeout)
+        finally:
+            # exactly-once: an exception between registration and this
+            # removal must not leave a dead collector entry that every
+            # later _mark_down walks forever (EX003)
+            with self._lock:
+                self._collectors.pop(token, None)
         acks = {
             wid: obj for wid, obj in col.acks.items() if obj.get("ok")
         }
@@ -610,13 +616,25 @@ class PartitionRouter:
                 have = g in p.parts or g in p.assigned
             if have:
                 continue
-            wire = {
-                "op": ("partial_topk" if p.op == "topk"
-                       else "partial_scores"),
-                "range": g, "row": p.row, "k": p.k,
-                "cols": p.tile.get("cols"), "vals": p.tile.get("vals"),
-                "d_source": p.tile.get("d_source"),
-            }
+            # per-op wires: partial_scores ignores row/k (the full
+            # slice includes the self pair by definition), so sending
+            # them was dead weight the schema gate flags (WC103)
+            if p.op == "topk":
+                wire = {
+                    "op": "partial_topk",
+                    "range": g, "row": p.row, "k": p.k,
+                    "cols": p.tile.get("cols"),
+                    "vals": p.tile.get("vals"),
+                    "d_source": p.tile.get("d_source"),
+                }
+            else:
+                wire = {
+                    "op": "partial_scores",
+                    "range": g,
+                    "cols": p.tile.get("cols"),
+                    "vals": p.tile.get("vals"),
+                    "d_source": p.tile.get("d_source"),
+                }
             if not self._dispatch_sub(p, g, self._holders(g), wire):
                 return  # parked or failed; stop fanning out
 
@@ -740,7 +758,14 @@ class PartitionRouter:
             self._advance(p)
             return
         result = obj.get("result") or {}
-        self._absorb(p, key, result)
+        try:
+            self._absorb(p, key, result)
+        except Exception as exc:
+            # a malformed partial (or a merge bug) must resolve the
+            # scatter, not leak it: an unhandled exception here is
+            # swallowed by the transport reader's guard and the client
+            # future would hang forever
+            self._fail(p, f"merge failed: {exc!r}")
 
     def _absorb(self, p: _Scatter, key, result: dict) -> None:
         """Fold one ok sub-response into the scatter and advance."""

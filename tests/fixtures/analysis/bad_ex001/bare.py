@@ -1,0 +1,10 @@
+"""BAD: bare acquire, release skipped on exception exits (EX001)."""
+import threading
+
+_LOCK = threading.Lock()
+
+
+def withdraw(account, amount):
+    _LOCK.acquire()
+    account.debit(amount)
+    _LOCK.release()

@@ -1,0 +1,9 @@
+"""BAD: handle closed only on the happy path (EX002)."""
+import json
+
+
+def load_manifest(path):
+    f = open(path, "r", encoding="utf-8")
+    data = json.load(f)
+    f.close()
+    return data

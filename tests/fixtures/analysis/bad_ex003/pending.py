@@ -1,0 +1,12 @@
+"""BAD: a raise between insert and removal leaks the entry (EX003)."""
+
+
+class Pending:
+    def __init__(self):
+        self._pending = {}
+
+    def run(self, rid, work):
+        self._pending[rid] = work
+        result = work()
+        self._pending.pop(rid, None)
+        return result

@@ -1,0 +1,17 @@
+"""BAD: two paths acquire the same locks in opposite orders (LD101)."""
+import threading
+
+_A = threading.Lock()
+_B = threading.Lock()
+
+
+def forward(jobs):
+    with _A:
+        with _B:
+            jobs.append("f")
+
+
+def backward(jobs):
+    with _B:
+        with _A:
+            jobs.append("b")

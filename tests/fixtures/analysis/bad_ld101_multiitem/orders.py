@@ -1,0 +1,15 @@
+"""BAD: opposite orders spelled as multi-item withs (LD101)."""
+import threading
+
+_A = threading.Lock()
+_B = threading.Lock()
+
+
+def forward(jobs):
+    with _A, _B:
+        jobs.append("f")
+
+
+def backward(jobs):
+    with _B, _A:
+        jobs.append("b")

@@ -1,0 +1,16 @@
+"""BAD: blocking queue.get() while holding a lock (LD102)."""
+import queue
+import threading
+
+
+class Pump:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = queue.Queue()
+        self.last = None
+
+    def take(self):
+        with self._lock:
+            item = self._q.get()
+            self.last = item
+            return item

@@ -1,0 +1,14 @@
+"""BAD: transport send while holding a lock (LD103)."""
+import threading
+
+
+class Fanout:
+    def __init__(self, transport):
+        self._lock = threading.Lock()
+        self.transport = transport
+        self.sent = 0
+
+    def push(self, wire):
+        with self._lock:
+            self.transport.send(wire)
+            self.sent += 1

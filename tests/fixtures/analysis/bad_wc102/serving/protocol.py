@@ -1,0 +1,10 @@
+"""Fixture protocol: two ops with defaulted reads."""
+PROTOCOL_OPS = frozenset({"ping", "echo"})
+
+
+def _dispatch_op(service, op, req):
+    if op == "ping":
+        return {"pong": True}
+    if op == "echo":
+        return {"text": req.get("text")}
+    raise KeyError(op)

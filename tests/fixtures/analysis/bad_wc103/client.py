@@ -1,0 +1,5 @@
+"""The producing side: sends a field ping never reads."""
+
+
+def probe(transport):
+    transport.send({"op": "ping", "echo_tag": 1})

@@ -1,0 +1,8 @@
+"""BAD: a producer writes a field no handler reads (WC103)."""
+PROTOCOL_OPS = frozenset({"ping"})
+
+
+def _dispatch_op(service, op, req):
+    if op == "ping":
+        return {"pong": True}
+    raise KeyError(op)

@@ -1,0 +1,12 @@
+"""GOOD: release guaranteed in a finally (EX001)."""
+import threading
+
+_LOCK = threading.Lock()
+
+
+def withdraw(account, amount):
+    _LOCK.acquire()
+    try:
+        account.debit(amount)
+    finally:
+        _LOCK.release()

@@ -1,0 +1,7 @@
+"""GOOD: with-managed handle (EX002)."""
+import json
+
+
+def load_manifest(path):
+    with open(path, "r", encoding="utf-8") as f:
+        return json.load(f)

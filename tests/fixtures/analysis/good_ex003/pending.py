@@ -1,0 +1,13 @@
+"""GOOD: removal in a finally — exactly-once on every exit (EX003)."""
+
+
+class Pending:
+    def __init__(self):
+        self._pending = {}
+
+    def run(self, rid, work):
+        self._pending[rid] = work
+        try:
+            return work()
+        finally:
+            self._pending.pop(rid, None)

@@ -1,0 +1,17 @@
+"""GOOD: one global acquisition order, everywhere (LD101)."""
+import threading
+
+_A = threading.Lock()
+_B = threading.Lock()
+
+
+def forward(jobs):
+    with _A:
+        with _B:
+            jobs.append("f")
+
+
+def also_forward(jobs):
+    with _A:
+        with _B:
+            jobs.append("g")

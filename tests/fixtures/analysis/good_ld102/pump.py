@@ -1,0 +1,16 @@
+"""GOOD: wait outside the critical section, store inside (LD102)."""
+import queue
+import threading
+
+
+class Pump:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = queue.Queue()
+        self.last = None
+
+    def take(self):
+        item = self._q.get()
+        with self._lock:
+            self.last = item
+        return item

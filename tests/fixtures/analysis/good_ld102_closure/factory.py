@@ -1,0 +1,21 @@
+"""GOOD: defining a blocking callback under a lock is not blocking
+under a lock — the closure runs later, on another thread (LD102)."""
+import queue
+import threading
+
+
+class Hub:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = queue.Queue()
+        self.registered = 0
+
+    def _make_cb(self):
+        def cb():
+            return self._q.get()
+        return cb
+
+    def start(self, register):
+        with self._lock:
+            self.registered += 1
+            register(self._make_cb())

@@ -1,0 +1,14 @@
+"""GOOD: send after releasing; the lock only guards bookkeeping (LD103)."""
+import threading
+
+
+class Fanout:
+    def __init__(self, transport):
+        self._lock = threading.Lock()
+        self.transport = transport
+        self.sent = 0
+
+    def push(self, wire):
+        self.transport.send(wire)
+        with self._lock:
+            self.sent += 1

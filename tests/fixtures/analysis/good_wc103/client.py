@@ -1,0 +1,5 @@
+"""The producing side: the field is read by the ping handler."""
+
+
+def probe(transport):
+    transport.send({"op": "ping", "echo_tag": 1})

@@ -1,0 +1,8 @@
+"""GOOD: every produced field has a reader (WC103)."""
+PROTOCOL_OPS = frozenset({"ping"})
+
+
+def _dispatch_op(service, op, req):
+    if op == "ping":
+        return {"pong": True, "echo_tag": req.get("echo_tag")}
+    raise KeyError(op)

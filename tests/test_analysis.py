@@ -236,3 +236,154 @@ def test_lint_runs_via_main_cli(capsys):
 
     assert main(["lint", "--rules", "WC001"]) == 0
     assert "finding(s)" in capsys.readouterr().out
+
+
+# -- PR-12 surfaces: SARIF, parse cache, grouped catalog, call graph -------
+
+
+def test_list_rules_grouped_by_family(capsys):
+    """Satellite contract: the catalog groups the ~25 rules by pass
+    family with one-line docs from registry.RULES."""
+    from distributed_pathsim_tpu.analysis.cli import lint_main
+    from distributed_pathsim_tpu.analysis.registry import (
+        PASS_FAMILIES,
+        RULES,
+    )
+
+    assert lint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for family in PASS_FAMILIES.values():
+        assert family in out, f"family header missing: {family}"
+    for rid, doc in RULES.items():
+        assert rid in out
+        assert doc.title in out
+
+
+def test_sarif_export_stable_and_carries_suppressions(tmp_path):
+    """--sarif: valid SARIF 2.1.0, byte-stable across runs, baselined
+    findings present as suppressed results, every rule in the driver."""
+    from distributed_pathsim_tpu.analysis import (
+        RULES,
+        load_baseline,
+        run_analysis,
+    )
+    from distributed_pathsim_tpu.analysis.sarif import render_sarif
+
+    result = run_analysis(baseline=load_baseline())
+    text = render_sarif(result)
+    assert text == render_sarif(run_analysis(baseline=load_baseline()))
+    doc = json.loads(text)
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert set(RULES) <= rule_ids and "BASELINE" in rule_ids
+    suppressed = [r for r in run["results"] if r.get("suppressions")]
+    assert len(suppressed) == len(result["suppressed"])
+    for r in run["results"]:
+        loc = r["locations"][0]["physicalLocation"]
+        assert loc["region"]["startLine"] >= 1
+        assert loc["artifactLocation"]["uri"]
+
+
+def test_parse_cache_cold_and_warm_stay_inside_the_gate(tmp_path):
+    """Satellite contract: the whole-repo run stays under the 10 s
+    tier-1 gate with the parse cache cold AND warm, and the cached
+    loader is bit-equivalent to the uncached one."""
+    from distributed_pathsim_tpu.analysis import (
+        load_baseline,
+        render_json,
+        run_analysis,
+    )
+    from distributed_pathsim_tpu.analysis.cache import load_modules_cached
+
+    cache = tmp_path / "parse.pkl"
+    t0 = time.perf_counter()
+    cold = load_modules_cached(cache_path=cache)
+    cold_result = run_analysis(baseline=load_baseline(), modules=cold)
+    cold_s = time.perf_counter() - t0
+    assert cache.exists()
+    t0 = time.perf_counter()
+    warm = load_modules_cached(cache_path=cache)
+    warm_result = run_analysis(baseline=load_baseline(), modules=warm)
+    warm_s = time.perf_counter() - t0
+    assert cold_s < 10.0, f"cold cache run too slow: {cold_s:.1f}s"
+    assert warm_s < 10.0, f"warm cache run too slow: {warm_s:.1f}s"
+    assert [m.repo_rel for m in warm] == [m.repo_rel for m in cold]
+    assert render_json(warm_result) == render_json(cold_result)
+    uncached = run_analysis(baseline=load_baseline())
+    assert render_json(uncached) == render_json(cold_result)
+
+
+def test_callgraph_engine_is_deterministic():
+    """The interprocedural backbone: resolved edges, reachability
+    chains, and SCCs are identical across runs (witness chains land in
+    finding messages — nondeterminism there breaks the byte-stable
+    JSON contract)."""
+    from distributed_pathsim_tpu.analysis import load_modules
+    from distributed_pathsim_tpu.analysis.callgraph import (
+        CallGraph,
+        propagate_reachability,
+        strongly_connected,
+    )
+    from distributed_pathsim_tpu.analysis.core import default_roots
+
+    modules = [
+        m for m in load_modules(default_roots())
+        if m.root_kind == "package"
+    ]
+    g1, g2 = CallGraph(modules), CallGraph(modules)
+    assert sorted(g1.by_fid) == sorted(g2.by_fid)
+    seeds = {
+        fid: "seed" for fid in sorted(g1.by_fid)
+        if fid.endswith(":shared_lib")
+    }
+    assert seeds, "native.build.shared_lib should be indexed"
+    r1 = propagate_reachability(g1, seeds)
+    r2 = propagate_reachability(g2, seeds)
+    assert r1 == r2
+    # the service warm path reaches the native build (the LD102
+    # baseline entry's justification, machine-checked here)
+    assert any("service.py" in fid for fid in r1)
+    edges = {"a": {"b"}, "b": {"a"}, "c": {"c"}, "d": {"a"}}
+    assert strongly_connected(edges) == [["a", "b"], ["c"]]
+
+
+def test_interprocedural_entry_held_is_conservative():
+    """A PUBLIC method never inherits caller lock facts (external
+    callers are unknown): the fixture's public helper called under a
+    lock must not make its own blocking call a finding."""
+    from distributed_pathsim_tpu.analysis.core import Module
+    from distributed_pathsim_tpu.analysis.interlocks import InterLockPass
+    import ast as _ast
+    import pathlib as _pl
+
+    src = (
+        "import queue\nimport threading\n\n\n"
+        "class Svc:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._q = queue.Queue()\n"
+        "        self.state = 0\n\n"
+        "    def tick(self):\n"
+        "        with self._lock:\n"
+        "            self.state += 1\n"
+        "            self.fetch()\n\n"   # public: fact must NOT flow
+        "    def fetch(self):\n"
+        "        return self._q.get()\n\n"
+        "    def _locked_fetch(self):\n"  # private: fact DOES flow
+        "        return self._q.get()\n\n"
+        "    def tock(self):\n"
+        "        with self._lock:\n"
+        "            self.state += 1\n"
+        "            self._locked_fetch()\n"
+    )
+    m = Module(
+        path=_pl.Path("svc.py"), rel="svc.py", repo_rel="svc.py",
+        root_kind="package", text=src, tree=_ast.parse(src),
+    )
+    findings = InterLockPass().run([m])
+    rules = sorted((f.rule, f.symbol) for f in findings)
+    assert ("LD102", "Svc._locked_fetch") in rules or (
+        "LD102", "Svc.tock"
+    ) in rules
+    assert not any(sym == "Svc.fetch" for _r, sym in rules), rules
