@@ -4,8 +4,9 @@
 PYTHON ?= python
 
 .PHONY: test chaos chaos-router serve-smoke update-smoke obs-smoke \
-	router-smoke partition-smoke ann-smoke fleet-obs-smoke lint \
-	lint-schema lint-telemetry tune-smoke lint-tuning tune
+	router-smoke partition-smoke ann-smoke fleet-obs-smoke \
+	metapath-smoke lint lint-schema lint-telemetry tune-smoke \
+	lint-tuning tune
 
 # Tier-1: the fast CPU suite (the driver's acceptance gate).
 test:
@@ -103,6 +104,19 @@ obs-smoke:
 # so tier-1 covers it.
 fleet-obs-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) bench_serving.py --regime fleet-obs --smoke
+
+# Metapath planner smoke: the DP chain planner beats the naive
+# left-to-right fold on a measured asymmetric chain (estimated AND
+# wall time, results bit-identical), a mixed APVPA/APA/APTPA
+# closed-loop workload through the per-request metapath lanes shares
+# >=1 memoized sub-chain across engines, every lane's answers are
+# bit-identical to dedicated per-metapath oracles, and the compile
+# ledger stays at zero across the measured window (delta-interleaved
+# engine rebuilds included). The same run is wired as a non-slow
+# pytest (tests/test_planner.py::test_bench_metapath_smoke), so
+# tier-1 covers it.
+metapath-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) bench_serving.py --regime metapath --smoke
 
 # Unified static analysis (analysis/, DESIGN.md §25/§27):
 # recompile-safety, lock-discipline + interprocedural lock-order /

@@ -2156,13 +2156,309 @@ def run_partition_smoke(out_path: str | None = None) -> dict:
     return result
 
 
+# ---------------------------------------------------------------------------
+# Metapath planner regime (--regime metapath): DP chain ordering vs the
+# naive left-to-right fold, plus the workload-level sub-chain memo
+# (BENCH_METAPATH artifact; DESIGN.md §28)
+# ---------------------------------------------------------------------------
+
+
+def _best_of(fn, reps: int) -> tuple[float, object]:
+    """(best wall seconds, last result) over ``reps`` calls."""
+    best = float("inf")
+    out = None
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn()
+        best = min(best, time.perf_counter() - t0)
+    return best, out
+
+
+def _metapath_ordering_phase(n_authors, n_papers, n_venues, n_topics,
+                             reps, seed) -> dict:
+    """Planner (DP) vs naive left-to-right on an asymmetric chain where
+    association order genuinely matters: APVPT runs tall·narrow·tall·
+    wide (A×P · P×V · V×P · P×T), so the naive fold pays the full-width
+    A×P intermediate against the topic block while the DP contracts
+    V·P·T down to a tiny V×T first. Both estimated and measured costs
+    are recorded; results are asserted bit-identical (integer counts
+    are association-invariant — that is WHY ordering is a free lever)."""
+    from distributed_pathsim_tpu.data.synthetic import synthetic_hin
+    from distributed_pathsim_tpu.ops import chain as _chain
+    from distributed_pathsim_tpu.ops import planner
+    from distributed_pathsim_tpu.ops.metapath import compile_metapath
+
+    hin = synthetic_hin(
+        n_authors, n_papers, n_venues, n_topics=n_topics,
+        topics_per_paper=1.4, seed=seed,
+    )
+    mp = compile_metapath("APVPT", hin.schema)
+    plan = planner.plan_metapath(hin, mp)
+    blocks = _chain.oriented_dense_blocks(hin, mp.steps, dtype=np.float64)
+    t_dp, m_dp = _best_of(
+        lambda: planner.execute_dense(plan, blocks, xp=np), reps
+    )
+    t_naive, m_naive = _best_of(
+        lambda: planner.naive_dense(blocks, xp=np), reps
+    )
+    assert np.array_equal(m_dp, m_naive), (
+        "association order changed integer path counts — planner bug"
+    )
+    return {
+        "metapath": mp.name,
+        "shapes": [list(b.shape) for b in blocks],
+        "plan_order": plan.order(),
+        "dp_ran": plan.dp,
+        "est_flops_planner": plan.est_flops,
+        "est_flops_naive": plan.naive_flops,
+        "est_speedup": round(plan.naive_flops / max(plan.est_flops, 1), 3),
+        "measured_ms_planner": round(t_dp * 1e3, 3),
+        "measured_ms_naive": round(t_naive * 1e3, 3),
+        "measured_speedup": round(t_naive / max(t_dp, 1e-9), 3),
+        "bit_identical": True,
+        "plan": plan.to_dict(),
+    }
+
+
+_MP_WORKLOAD_SPECS = ("APVPA", "APA", "APTPA")
+
+
+def _metapath_workload_arm(hin_kwargs, backend, max_batch, max_wait_ms,
+                           k, clients, queries_per_client, rounds,
+                           memo_on: bool, seed: int) -> dict:
+    """One closed-loop arm of the mixed-metapath workload: warm the
+    three engines, then alternate query rounds with delta rounds (a
+    delta drops the engines, so the next round pays the re-fold — the
+    regime the sub-chain memo exists for). Returns throughput, memo
+    accounting, the compile ledger, and a bit-identity audit vs
+    dedicated per-metapath oracles."""
+    from distributed_pathsim_tpu.data.synthetic import synthetic_hin
+    from distributed_pathsim_tpu.data.delta import with_headroom
+    from distributed_pathsim_tpu.ops.metapath import compile_metapath
+    from distributed_pathsim_tpu.backends.base import create_backend
+    from distributed_pathsim_tpu.serving import PathSimService, ServeConfig
+    from distributed_pathsim_tpu.utils.xla_flags import CompileCounter
+
+    hin = with_headroom(synthetic_hin(**hin_kwargs), 0.25)
+    mp = compile_metapath("APVPA", hin.schema)
+    svc = PathSimService(
+        create_backend(backend, hin, mp),
+        config=ServeConfig(
+            max_batch=max_batch, max_wait_ms=max_wait_ms,
+            queue_depth=4096, k_default=k, warm=True,
+            memo_budget_mb=(64.0 if memo_on else 0.0),
+        ),
+    )
+    rng = np.random.default_rng(seed)
+    n = svc.n
+    try:
+        # -- warmup: build + warm every engine, pre-compile the delta
+        # scatter programs (one warmup update, like the update smoke)
+        for spec in _MP_WORKLOAD_SPECS:
+            svc.topk_index(0, k=k, metapath=spec)
+        delta0 = _random_delta(hin, rng, 0.002, append_nodes=False)
+        svc.update(delta0)
+        for spec in _MP_WORKLOAD_SPECS:
+            svc.topk_index(1, k=k, metapath=spec)
+
+        # -- bit-identity audit vs dedicated oracles on the live graph
+        oracle_hin = svc.hin
+        audit_ok = True
+        for spec in _MP_WORKLOAD_SPECS:
+            oracle = create_backend(
+                "numpy", oracle_hin, compile_metapath(spec, hin.schema)
+            )
+            for row in rng.integers(0, n, size=4):
+                want_v, want_i = oracle.topk_row(int(row), k=k)
+                got_v, got_i = svc.topk_index(int(row), k=k, metapath=spec)
+                audit_ok = audit_ok and np.array_equal(got_i, want_i) \
+                    and np.array_equal(got_v, want_v)
+
+        # -- measured window: closed-loop mixed-metapath clients, one
+        # delta per round (drops engines → next round refolds, hitting
+        # the memo for factors the delta did not touch)
+        schedule = [
+            rng.integers(0, n, size=queries_per_client).tolist()
+            for _ in range(clients)
+        ]
+        total_queries = 0
+        t0 = time.perf_counter()
+        with CompileCounter() as cc:
+            for rnd in range(rounds):
+                def client(ci: int, rows) -> int:
+                    done = 0
+                    for qi, row in enumerate(rows):
+                        spec = _MP_WORKLOAD_SPECS[(ci + qi) % 3]
+                        svc.topk_index(int(row), k=k, metapath=spec)
+                        done += 1
+                    return done
+
+                from concurrent.futures import ThreadPoolExecutor
+
+                with ThreadPoolExecutor(max_workers=clients) as ex:
+                    total_queries += sum(
+                        ex.map(client, range(clients), schedule)
+                    )
+                if rnd < rounds - 1:
+                    svc.update(
+                        _random_delta(svc.hin, rng, 0.002,
+                                      append_nodes=False)
+                    )
+            wall = time.perf_counter() - t0
+            compiles = cc.count
+        stats = svc.stats()
+        memo = stats["plan"]["memo"]
+        return {
+            "memo_on": memo_on,
+            "queries": total_queries,
+            "wall_s": round(wall, 4),
+            "qps": round(total_queries / max(wall, 1e-9), 1),
+            "steady_state_compiles": compiles,
+            "memo": memo,
+            "engines": stats["plan"]["engines"],
+            "bit_identical_vs_oracles": audit_ok,
+        }
+    finally:
+        svc.close()
+
+
+def run_metapath_bench(
+    n_authors: int = 2048,
+    n_papers: int = 4096,
+    n_venues: int = 12,
+    n_topics: int = 128,
+    clients: int = 16,
+    queries_per_client: int = 32,
+    rounds: int = 3,
+    reps: int = 3,
+    k: int = 10,
+    backend: str = "jax",
+    max_batch: int = 32,
+    max_wait_ms: float = 2.0,
+    seed: int = 0,
+    out_path: str | None = None,
+) -> dict:
+    """``--regime metapath``: (1) DP chain ordering vs naive
+    left-to-right on a measured asymmetric chain (estimated AND wall
+    time, bit-identity asserted); (2) a mixed APVPA/APA/APTPA
+    closed-loop workload through the per-request ``metapath`` lanes,
+    memo-on vs memo-off arms (hit rate, QPS, engine-rebuild sharing
+    across deltas) with the steady-state compile ledger."""
+    ordering = _metapath_ordering_phase(
+        n_authors, n_papers, n_venues, n_topics, reps, seed
+    )
+    hin_kwargs = dict(
+        n_authors=n_authors, n_papers=n_papers, n_venues=n_venues,
+        n_topics=max(n_topics // 8, 8), topics_per_paper=1.2, seed=seed,
+    )
+    arm_kwargs = dict(
+        hin_kwargs=hin_kwargs, backend=backend, max_batch=max_batch,
+        max_wait_ms=max_wait_ms, k=k, clients=clients,
+        queries_per_client=queries_per_client, rounds=rounds, seed=seed,
+    )
+    memo_arm = _metapath_workload_arm(memo_on=True, **arm_kwargs)
+    nomemo_arm = _metapath_workload_arm(memo_on=False, **arm_kwargs)
+
+    # Direct sub-chain refold cost, warm vs cold: the component the
+    # memo actually accelerates (engine rebuilds after a delta). The
+    # closed-loop QPS arms above are dominated by query serving at
+    # bench scale, so the fold win is reported where it is measurable.
+    from distributed_pathsim_tpu.data.synthetic import synthetic_hin
+    from distributed_pathsim_tpu.ops import planner
+    from distributed_pathsim_tpu.ops.metapath import compile_metapath
+
+    refold_hin = synthetic_hin(**hin_kwargs)
+    paths = [
+        compile_metapath(spec, refold_hin.schema)
+        for spec in _MP_WORKLOAD_SPECS
+    ]
+    t_cold, _ = _best_of(
+        lambda: [planner.fold_half(refold_hin, p) for p in paths], reps
+    )
+    memo = planner.SubchainCache(64 << 20)
+    for p in paths:
+        planner.fold_half(refold_hin, p, memo=memo)  # populate
+    t_warm, _ = _best_of(
+        lambda: [planner.fold_half(refold_hin, p, memo=memo)
+                 for p in paths], reps
+    )
+    refold = {
+        "specs": list(_MP_WORKLOAD_SPECS),
+        "cold_ms": round(t_cold * 1e3, 3),
+        "warm_ms": round(t_warm * 1e3, 3),
+        "memo_fold_speedup": round(t_cold / max(t_warm, 1e-9), 2),
+    }
+    shared = (
+        memo_arm["memo"] is not None
+        and memo_arm["memo"]["hits"] > 0
+        and len(memo_arm["engines"]) >= 2
+    )
+    result = {
+        "bench": "metapath",
+        "config": {
+            "authors": n_authors, "papers": n_papers,
+            "venues": n_venues, "topics": n_topics,
+            "clients": clients, "rounds": rounds, "k": k,
+            "backend": backend, "seed": seed,
+        },
+        "ordering": ordering,
+        "workload": {
+            "specs": list(_MP_WORKLOAD_SPECS),
+            "memo_on": memo_arm,
+            "memo_off": nomemo_arm,
+            "memo_qps_uplift": round(
+                memo_arm["qps"] / max(nomemo_arm["qps"], 1e-9), 3
+            ),
+            "refold": refold,
+        },
+        "checks": {
+            "planner_beats_naive_measured": (
+                ordering["measured_ms_planner"]
+                < ordering["measured_ms_naive"]
+            ),
+            "planner_beats_naive_estimated": (
+                ordering["est_flops_planner"] < ordering["est_flops_naive"]
+            ),
+            "memo_subchain_shared_across_lanes": shared,
+            "mixed_lanes_bit_identical": (
+                memo_arm["bit_identical_vs_oracles"]
+                and nomemo_arm["bit_identical_vs_oracles"]
+            ),
+            "zero_steady_state_recompiles": (
+                memo_arm["steady_state_compiles"] == 0
+                and nomemo_arm["steady_state_compiles"] == 0
+            ),
+        },
+    }
+    if out_path:
+        with open(out_path, "w", encoding="utf-8") as f:
+            json.dump(result, f, indent=2)
+    return result
+
+
+def run_metapath_smoke(out_path: str | None = None) -> dict:
+    """Small fixed-seed metapath run with hard gates (the
+    ``make metapath-smoke`` / tier-1 wiring). The ordering shapes are
+    skewed (wide topic axis) so the planner-vs-naive wall-time gap is
+    ~10x, far above scheduler noise."""
+    result = run_metapath_bench(
+        n_authors=768, n_papers=1536, n_venues=8, n_topics=96,
+        clients=6, queries_per_client=12, rounds=2, reps=3, k=5,
+        backend="jax", max_batch=8, max_wait_ms=1.0, seed=7,
+        out_path=out_path,
+    )
+    if not all(result["checks"].values()):
+        raise AssertionError(f"metapath smoke failed: {result['checks']}")
+    return result
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--smoke", action="store_true",
                    help="small fixed run with hard pass/fail gates")
     p.add_argument("--regime", default="load",
                    choices=("load", "update", "obs", "router", "ann",
-                            "fleet-obs", "partition"),
+                            "fleet-obs", "partition", "metapath"),
                    help="'load': the closed-loop QPS regimes; 'update': "
                    "delta-ingestion vs reload latency; 'obs': "
                    "observability overhead (obs on vs off, steady "
@@ -2195,7 +2491,19 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--out", default=None, help="write the JSON here")
     args = p.parse_args(argv)
 
-    if args.regime == "partition":
+    if args.regime == "metapath":
+        if args.smoke:
+            result = run_metapath_smoke(args.out)
+        else:
+            result = run_metapath_bench(
+                n_authors=args.authors, n_papers=args.papers,
+                n_venues=args.venues, clients=args.clients,
+                queries_per_client=args.queries_per_client,
+                reps=args.reps, k=args.k, backend=args.backend,
+                max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
+                seed=args.seed, out_path=args.out,
+            )
+    elif args.regime == "partition":
         if args.smoke:
             result = run_partition_smoke(args.out)
         else:

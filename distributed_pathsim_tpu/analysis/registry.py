@@ -16,6 +16,7 @@ from .determinism import DeterminismPass
 from .exceptions import ExceptionSafetyPass
 from .interlocks import InterLockPass
 from .locks import LockDisciplinePass
+from .metapath_ir import MetapathIRPass
 from .partition import PartitionOwnershipPass
 from .recompile import RecompileSafetyPass
 from .telemetry import TelemetryPass
@@ -45,6 +46,7 @@ PASS_FAMILIES: dict[str, str] = {
     "TuningConstantsPass": "tuning constants (TN)",
     "PartitionOwnershipPass": "partition ownership (PT)",
     "ExceptionSafetyPass": "exception safety / exactly-once (EX)",
+    "MetapathIRPass": "metapath planner IR, interprocedural (MP)",
 }
 
 ALL_PASSES = (
@@ -58,6 +60,7 @@ ALL_PASSES = (
     TuningConstantsPass(),
     PartitionOwnershipPass(),
     ExceptionSafetyPass(),
+    MetapathIRPass(),
 )
 
 RULES: dict[str, RuleDoc] = {}

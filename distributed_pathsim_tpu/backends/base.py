@@ -23,6 +23,7 @@ from typing import Any, Callable
 import numpy as np
 
 from ..data.encode import EncodedHIN
+from ..ops import planner
 from ..ops.metapath import MetaPath
 from ..ops import pathsim
 
@@ -52,6 +53,20 @@ class PathSimBackend(abc.ABC):
         self.hin = hin
         self.metapath = metapath
         self.options = options
+        # The chain is data: every backend executes an EvalPlan —
+        # DP-ordered association over the (half-)chain with estimated
+        # FLOPs/density on every node (ops/planner.py, DESIGN.md §28).
+        # Plan construction is memoized per (HIN, metapath), so N
+        # backends over one graph share one stats scan.
+        self.plan = planner.plan_metapath(hin, metapath)
+        # Workload-level sub-chain memo (serving passes the shared
+        # SubchainCache so concurrent metapath engines share folds).
+        self._subchain_memo = options.get("subchain_memo")
+
+    def describe_plan(self) -> dict:
+        """The auditable plan dump: association order + per-node cost
+        estimates (the ``stats()``/bench surface of plan choices)."""
+        return self.plan.to_dict()
 
     @property
     def n_sources(self) -> int:

@@ -22,21 +22,31 @@ import numpy as np
 
 from ..ops import chain
 from ..ops import pallas_kernels as pk
-from ..ops import sparse as sp
+from ..ops import planner
 from .base import PathSimBackend, register_backend
 
 
-@jax.jit
-def _chain_outputs(blocks):
-    """(M, rowsums) for a non-symmetric oriented chain, on device.
+@functools.lru_cache(maxsize=None)
+def _chain_outputs_for(order):
+    """(M, rowsums) program for a non-symmetric oriented chain, on
+    device, in the PLAN's association order. One jitted program per
+    order tree (lru-cached at module level, like every other compiled
+    core here): the plan resolves outside the jit, so a rebuilt backend
+    over the same chain reuses the compiled program — the zero
+    steady-state-recompile contract extended to general metapaths.
 
-    ``highest`` matmul precision: counts are integers, bf16-pass matmuls
-    would truncate them.
+    ``highest`` matmul precision: counts are integers, bf16-pass
+    matmuls would truncate them.
     """
-    with jax.default_matmul_precision("highest"):
-        m = chain.chain_product(blocks, xp=jnp)
-        rowsums = jnp.sum(m, axis=1)
-    return m, rowsums
+
+    @jax.jit
+    def run(blocks):
+        with jax.default_matmul_precision("highest"):
+            m = planner.execute_dense_order(order, list(blocks), xp=jnp)
+            rowsums = jnp.sum(m, axis=1)
+        return m, rowsums
+
+    return run
 
 
 @functools.partial(jax.jit, static_argnames=("shape",))
@@ -95,9 +105,28 @@ def _pairwise_rows_half(c, rows):
 @jax.jit
 def _rowsums_asym(blocks):
     """Row sums of an arbitrary chain by folding the ones-vector from the
-    right — never materializes anything wider than a block."""
+    right — never materializes anything wider than a block (a vector
+    fold is association-optimal already; the planner sanctions it)."""
     with jax.default_matmul_precision("highest"):
-        return chain.rowsums_general(blocks, xp=jnp)
+        return planner.rowsums_fold(blocks, xp=jnp)
+
+
+def _pad_coo_bucket(rows, cols, weights):
+    """Pad a COO triple to a power-of-two nnz bucket (floor 8): both
+    the construction-time factor scatter and the delta patch trace on
+    the padded length, so steady-state rebuilds and updates reuse one
+    compiled program per bucket. Pad entries carry weight 0 at (0, 0)
+    and scatter harmlessly. One definition for both sites — the
+    compile-cache keying must never drift between them."""
+    nnz = int(rows.shape[0])
+    bucket = max(8, 1 << (max(nnz, 1) - 1).bit_length())
+    r = np.zeros(bucket, dtype=np.int64)
+    c = np.zeros(bucket, dtype=np.int64)
+    w = np.zeros(bucket, dtype=np.float64)
+    r[:nnz] = rows
+    c[:nnz] = cols
+    w[:nnz] = weights
+    return r, c, w
 
 
 @register_backend("jax")
@@ -123,15 +152,30 @@ class JaxDenseBackend(PathSimBackend):
         if self._symmetric:
             # Sparse-first: only the folded COO indices cross host→device
             # (O(nnz), not O(N·P) dense blocks); C is scatter-assembled
-            # inside jit. See _half_outputs_coo.
-            coo = sp.half_chain_coo(hin, metapath)
+            # inside jit. See _half_outputs_coo. The fold is plan-ordered
+            # and shares sub-chains through the serving memo when one is
+            # installed (ops/planner.py).
+            coo = planner.fold_half(
+                hin, metapath, memo=self._subchain_memo, plan=self.plan
+            )
             self._c_shape = coo.shape
+            # Pad the factor COO to a power-of-two nnz bucket before it
+            # becomes a traced shape: _half_outputs_coo specializes on
+            # nnz, and a rebuilt backend over a delta-drifted graph
+            # (serving's lazy metapath-engine rebuilds, the delta-
+            # fallback path) would otherwise recompile the scatter on
+            # every rebuild. Pad entries scatter 0.0 at (0, 0) —
+            # harmless — and steady-state rebuilds reuse one compiled
+            # program per bucket.
+            rows, cols, w = _pad_coo_bucket(
+                coo.rows, coo.cols, coo.weights
+            )
             self._coo = tuple(
                 jax.device_put(jnp.asarray(a, dt), device)
                 for a, dt in (
-                    (coo.rows, jnp.int32),
-                    (coo.cols, jnp.int32),
-                    (coo.weights, dtype),
+                    (rows, jnp.int32),
+                    (cols, jnp.int32),
+                    (w, dtype),
                 )
             )
             self._blocks = None
@@ -167,7 +211,9 @@ class JaxDenseBackend(PathSimBackend):
                 c, rowsums = self._half()
                 m = _m_from_half(c)
             else:
-                m, rowsums = _chain_outputs(self._blocks)
+                m, rowsums = _chain_outputs_for(self.plan.order_tree())(
+                    self._blocks
+                )
             self._m = np.asarray(m, dtype=np.float64)
             self._rowsums = np.asarray(rowsums, dtype=np.float64)
             self._check_exact(self._rowsums)
@@ -256,19 +302,12 @@ class JaxDenseBackend(PathSimBackend):
                 "jax backend patches only the symmetric half factor"
             )
         dc = plan.delta_c
-        nnz = int(dc.rows.shape[0])
-        bucket = max(8, 1 << (max(nnz, 1) - 1).bit_length())
-        rows = np.zeros(bucket, dtype=np.int32)
-        cols = np.zeros(bucket, dtype=np.int32)
-        w = np.zeros(bucket, dtype=np.float64)
-        rows[:nnz] = dc.rows
-        cols[:nnz] = dc.cols
-        w[:nnz] = dc.weights
+        rows, cols, w = _pad_coo_bucket(dc.rows, dc.cols, dc.weights)
         c, _ = self._half()
         c_new = _apply_coo_delta(
             c,
-            jnp.asarray(rows),
-            jnp.asarray(cols),
+            jnp.asarray(rows, dtype=jnp.int32),
+            jnp.asarray(cols, dtype=jnp.int32),
             jnp.asarray(w, dtype=self.dtype),
         )
         # _half_cache is the single authority for (C, rowsums) — the

@@ -17,7 +17,6 @@ import numpy as np
 import jax
 
 from ..ops import chain
-from ..ops import sparse as sp
 from ..parallel.mesh import make_mesh
 from ..parallel.multihost import distributed_first_block, make_hybrid_mesh
 from ..parallel.sharded import (
@@ -92,7 +91,13 @@ class JaxShardedBackend(PathSimBackend):
         # million-author configuration requires. The sharded program then
         # starts at C (empty ``rest``): same collectives, far less data.
         self._np_dtype = np.dtype(dtype)
-        self._install_coo(sp.half_chain_coo(hin, metapath))
+        from ..ops import planner
+
+        self._install_coo(
+            planner.fold_half(
+                hin, metapath, memo=self._subchain_memo, plan=self.plan
+            )
+        )
 
     def _install_coo(self, coo) -> None:
         """Bind a (new) folded half-chain COO: exactness guard, host

@@ -71,7 +71,11 @@ class JaxSparseBackend(PathSimBackend):
             else int(dense_c_budget_bytes)
         )
         self._rect_kernel = rect_kernel
-        coo = sp.half_chain_coo(hin, metapath)
+        from ..ops import planner
+
+        coo = planner.fold_half(
+            hin, metapath, memo=self._subchain_memo, plan=self.plan
+        )
         from .. import tuning
 
         if tile_rows is None:

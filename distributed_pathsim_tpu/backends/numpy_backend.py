@@ -11,6 +11,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..ops import chain
+from ..ops import planner
 from .base import DeltaUnsupported, PathSimBackend, register_backend
 
 
@@ -20,8 +21,13 @@ class NumpyBackend(PathSimBackend):
         super().__init__(hin, metapath, **options)
         self.dtype = dtype
         if metapath.is_symmetric:
-            half = chain.oriented_dense_blocks(hin, metapath.half(), dtype=dtype)
-            self._c = chain.half_product(half, xp=np)
+            # Plan-ordered sparse fold, densified once: identical
+            # integers to the historical dense half_product (path
+            # counts are exact in f64 under any association order),
+            # without ever materializing the [N, P] intermediate.
+            self._c = planner.dense_half(
+                hin, metapath, dtype=dtype, memo=self._subchain_memo
+            )
             self._blocks = None
         else:
             self._c = None
@@ -37,7 +43,10 @@ class NumpyBackend(PathSimBackend):
             if self._c is not None:
                 self._m = chain.commuting_matrix_from_half(self._c, xp=np)
             else:
-                self._m = chain.chain_product(self._blocks, xp=np)
+                # DP-ordered association (the planner's whole point on
+                # asymmetric chains): identical integers to the naive
+                # left-to-right fold, measurably fewer FLOPs.
+                self._m = planner.execute_dense(self.plan, self._blocks, xp=np)
         return self._m[: self.n_sources, : self.n_targets]
 
     def global_walks(self) -> np.ndarray:
@@ -45,7 +54,7 @@ class NumpyBackend(PathSimBackend):
             if self._c is not None:
                 self._rowsums = chain.rowsums_from_half(self._c, xp=np)
             else:
-                self._rowsums = chain.rowsums_general(self._blocks, xp=np)
+                self._rowsums = planner.rowsums_fold(self._blocks, xp=np)
         return self._rowsums[: self.n_sources]
 
     def pairwise_row(self, source_index: int) -> np.ndarray:

@@ -77,9 +77,9 @@ def build_factor_slice(
     :func:`~..data.partition.slice_hin` for exactly ``held`` — the fold
     produces no support outside the held ranges, which is asserted, not
     assumed."""
-    from ..ops import sparse as sp
+    from ..ops import planner
 
-    coo = sp.half_chain_coo(hin_slice, metapath).summed()
+    coo = planner.fold_half(hin_slice, metapath).summed()
     rows_list = []
     range_slots: dict[int, tuple[int, int]] = {}
     at = 0

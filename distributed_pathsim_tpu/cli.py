@@ -94,6 +94,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dtype", default="float32", help="device dtype (float64 needs JAX_ENABLE_X64)")
     p.add_argument("--quiet", action="store_true", help="suppress stdout echo")
     p.add_argument(
+        "--explain-plan",
+        action="store_true",
+        help="print the metapath evaluation plan (DP association "
+        "order, estimated FLOPs/density per node) as JSON and exit "
+        "without computing anything",
+    )
+    p.add_argument(
         "--profile-dir",
         default=None,
         help="write a jax.profiler device trace (TensorBoard/Perfetto) here",
@@ -393,6 +400,8 @@ def _run(args) -> int:
         # the flag so EVERY seam honors it, not just the bootstrap ones
         # that receive the policy object explicitly.
         os.environ["PATHSIM_MAX_RETRIES"] = str(args.max_retries)
+    if args.explain_plan:
+        return _explain_plan(args)
     if "," in args.metapath:
         return _run_multipath(args)
     if args.ranking_out or args.checkpoint_dir:
@@ -469,6 +478,27 @@ def _run(args) -> int:
             print(obs.dump_trace(args.trace_out), file=sys.stderr)
         if args.metrics_file:
             obs.write_textfile(args.metrics_file)
+
+
+def _explain_plan(args) -> int:
+    """``--explain-plan``: load + compile + plan, never execute. The
+    dump is the auditable record of every ordering choice (estimated
+    FLOPs/density per node, DP vs left-to-right)."""
+    import json
+
+    from .engine import USE_NATIVE_BY_LOADER, load_dataset
+    from .ops.metapath import compile_metapath
+    from .ops.planner import plan_metapath
+
+    hin = load_dataset(
+        args.dataset, use_native=USE_NATIVE_BY_LOADER[args.loader]
+    )
+    out = {}
+    for spec in [s.strip() for s in args.metapath.split(",") if s.strip()]:
+        mp = compile_metapath(spec, hin.schema)
+        out[mp.name] = plan_metapath(hin, mp).to_dict()
+    print(json.dumps(out, indent=2, sort_keys=True))
+    return 0
 
 
 def _run_modes(args, config, logger: RunLogger, timer) -> int:

@@ -367,7 +367,7 @@ def half_chain_cached(hin: EncodedHIN, metapath):
     (``object.__setattr__`` on the frozen dataclass — same idiom as the
     fingerprint memo). plan_delta seeds the child HIN's entry with the
     patched factor, so a chain of deltas never refolds."""
-    from ..ops import sparse as sp
+    from ..ops import planner
 
     cache = hin.__dict__.get("_half_coo_cache")
     if cache is None:
@@ -375,7 +375,7 @@ def half_chain_cached(hin: EncodedHIN, metapath):
         object.__setattr__(hin, "_half_coo_cache", cache)
     c = cache.get(metapath.name)
     if c is None:
-        c = cache[metapath.name] = sp.half_chain_coo(hin, metapath).summed()
+        c = cache[metapath.name] = planner.fold_half(hin, metapath).summed()
     return c
 
 

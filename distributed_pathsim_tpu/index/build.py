@@ -42,9 +42,9 @@ def half_chain_and_denominators(
     """Dense half-chain factor C [N, V] (f64, exact integer counts) and
     the denominator vector of ``variant`` — the two host arrays both
     the index build and the exact candidate rerank read."""
-    from ..ops import sparse as sp
+    from ..ops import planner
 
-    c = sp.dense_half_chain(hin, metapath).astype(np.float64)
+    c = planner.dense_half(hin, metapath).astype(np.float64)
     if variant == "rowsum":
         d = c @ c.sum(axis=0)
     elif variant == "diagonal":

@@ -142,10 +142,10 @@ class MultiMetapathScorer:
         # [R, N, P] tensor — ~700 GB at the 227k dblp_large
         # reconstruction — while the streaming single-source path only
         # ever touches the O(nnz) factors.
-        from ..ops import sparse as sp
+        from ..ops import planner
 
         self._coo = [
-            sp.half_chain_coo(hin, m).summed() for m in self.metapaths
+            planner.fold_half(hin, m).summed() for m in self.metapaths
         ]
         self._c_stack_cache: jax.Array | None = None
         self._scores: np.ndarray | None = None

@@ -45,7 +45,6 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..data.encode import EncodedHIN
-from ..ops import sparse as sp
 from ..ops.metapath import MetaPath, compile_metapath
 
 
@@ -140,7 +139,9 @@ class NeuralPathSim:
         # contraction width). The dense [N, P] intermediate of a naive
         # chain product would be ~86 GB at the 65k x 327k bench shape —
         # backends/jax_dense.py:94 refuses it for the same reason.
-        c = sp.dense_half_chain(hin, self.metapath)
+        from ..ops import planner
+
+        c = planner.dense_half(hin, self.metapath)
         self._setup_from_c(
             c, dim=dim, hidden=hidden, lr=lr, seed=seed, variant=variant
         )

@@ -171,7 +171,10 @@ def coo_delta_fold(
     # General chain: diff the refolded factor (exact, not O(Δ) — the
     # backends keep no intermediate partials to apply the product rule
     # against). Callers treat a wide ΔC like any other; recompile-free
-    # serving is preserved either way.
+    # serving is preserved either way. The refold goes through the
+    # planner doorway (plan-ordered, MP001) like every other fold.
+    from . import planner
+
     new_blocks = []
     for ob, db in zip(old_blocks, delta_blocks):
         new_blocks.append(
@@ -182,8 +185,8 @@ def coo_delta_fold(
                 shape=ob.shape,
             ).summed()
         )
-    c_new = fold_half_chain(new_blocks)
-    c_old = fold_half_chain([b.summed() for b in old_blocks])
+    c_new = planner.fold_blocks(new_blocks)
+    c_old = planner.fold_blocks([b.summed() for b in old_blocks])
     merged = COOMatrix(
         rows=np.concatenate([c_new.rows, c_old.rows]),
         cols=np.concatenate([c_new.cols, c_old.cols]),
@@ -270,34 +273,25 @@ def affected_source_rows(
 
 
 def dense_half_chain(hin, metapath, dtype=np.float32) -> np.ndarray:
-    """Dense [N, V] half-chain factor via the sparse fold — the dense
-    [N, P] intermediate of a naive chain product never exists. Shared
-    by the model layer (neural + multipath scorers)."""
-    coo = half_chain_coo(hin, metapath).summed()
-    c = np.zeros(coo.shape, dtype=dtype)
-    c[coo.rows, coo.cols] = coo.weights
-    return c
+    """DEPRECATED shim → :func:`ops.planner.dense_half` (the planner
+    owns chain evaluation since the metapath-IR refactor, DESIGN.md
+    §28). Kept one release for external callers/tests."""
+    from . import planner
+
+    return planner.dense_half(hin, metapath, dtype=dtype)
 
 
 def half_chain_coo(hin, metapath) -> COOMatrix:
-    """Host-folded COO half-chain factor C for a symmetric metapath.
+    """DEPRECATED shim → :func:`ops.planner.fold_half` (plan-ordered,
+    bit-identical to the historical left-to-right fold). This was the
+    one structural join the whole run needs — the sparse analog of the
+    reference's per-query 4-way motif join (DPathSim_APVPA.py:72-84);
+    it now lives behind the planner doorway so sub-chain memoization
+    and DP ordering apply uniformly. Kept one release for external
+    callers/tests."""
+    from . import planner
 
-    This is the one structural join the whole run needs — the sparse
-    analog of the reference's per-query 4-way motif join
-    (DPathSim_APVPA.py:72-84), computed once and reused by every backend.
-    """
-    if not metapath.is_symmetric:
-        raise ValueError("half_chain_coo requires a symmetric metapath")
-    blocks = []
-    for st in metapath.half():
-        c = coo_from_block(hin.block(st.relationship))
-        if st.reverse:
-            c = COOMatrix(
-                rows=c.cols, cols=c.rows, weights=c.weights,
-                shape=(c.shape[1], c.shape[0]),
-            )
-        blocks.append(c)
-    return fold_half_chain(blocks)
+    return planner.fold_half(hin, metapath)
 
 
 # ---------------------------------------------------------------------------

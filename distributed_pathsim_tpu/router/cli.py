@@ -157,6 +157,8 @@ def _build_worker_service(args):
         ann_variant=args.ann_variant,
         ann_shadow_every=args.ann_shadow_every,
         ann_auto_refresh=not args.no_ann_refresh,
+        memo_budget_mb=args.memo_budget_mb,
+        max_metapaths=args.max_metapaths,
     )
     if args.dataset.startswith("synthetic:"):
         from ..backends.base import create_backend

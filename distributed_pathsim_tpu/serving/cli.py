@@ -31,7 +31,20 @@ def build_serve_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--dataset", default=RunConfig.dataset)
     p.add_argument("--backend", default="jax", choices=available_backends())
-    p.add_argument("--metapath", default="APVPA")
+    p.add_argument(
+        "--metapath", default="APVPA",
+        help="default served metapath; requests may override per query "
+        "via the protocol's 'metapath' field (closed metapaths only)",
+    )
+    p.add_argument(
+        "--memo-budget-mb", type=float, default=None,
+        help="sub-chain memo budget shared by all metapath engines "
+        "(default: the tuned plan_memo_budget_mb knob; 0 disables)",
+    )
+    p.add_argument(
+        "--max-metapaths", type=int, default=8,
+        help="bound on lazily-built per-request metapath engines",
+    )
     p.add_argument("--variant", default="rowsum", choices=list(VARIANTS))
     p.add_argument(
         "--loader", default="auto", choices=("auto", "python", "native")
@@ -221,6 +234,8 @@ def serve_main(argv: list[str] | None = None) -> int:
         ann_variant=args.ann_variant,
         ann_shadow_every=args.ann_shadow_every,
         ann_auto_refresh=not args.no_ann_refresh,
+        memo_budget_mb=args.memo_budget_mb,
+        max_metapaths=args.max_metapaths,
     )
     from .. import obs
 

@@ -24,6 +24,14 @@ Requests::
     {"id": 13, "op": "topk", "row": 17, "mode": "ann"}
     {"id": 14, "op": "refresh_index"}
 
+``topk`` and ``scores`` accept an optional defaulted ``metapath``
+(default: the service's ``--metapath``, itself defaulted to "APVPA"):
+any closed metapath spec over the served schema (``"APA"``,
+``"APTPA"``, …) is answered through a lazily-built, memo-sharing
+engine on its own coalescer lane — bit-identical to a service built
+with that ``--metapath`` (DESIGN.md §28). Yesterday's clients, which
+never send the field, are untouched.
+
 ``topk`` accepts an optional ``mode`` (``"exact"`` | ``"ann"``,
 default the service's ``--topk-mode``): ``ann`` answers through the
 MIPS candidate index + exact f64 rerank (DESIGN.md §23) and silently
@@ -220,6 +228,7 @@ def _dispatch_op(
             k=req.get("k"),
             timeout_s=deadline.remaining_s() if deadline else None,
             mode=req.get("mode"),
+            metapath=req.get("metapath"),
             **kwargs,
         )
         return {
@@ -253,8 +262,14 @@ def _dispatch_op(
             source=req.get("source"),
             source_id=req.get("source_id"),
             row=req.get("row"),
+            metapath=req.get("metapath"),
         )
-        return {"row": row, "scores": service.scores_index(row).tolist()}
+        return {
+            "row": row,
+            "scores": service.scores_index(
+                row, metapath=req.get("metapath")
+            ).tolist(),
+        }
     if op == "resolve":
         # label/id → global dense row; any worker answers (partition
         # workers keep FULL index spaces — only edges are sliced)

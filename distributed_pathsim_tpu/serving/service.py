@@ -36,9 +36,31 @@ from ..backends.base import PathSimBackend
 from ..obs.metrics import get_registry
 from ..obs.trace import get_tracer
 from ..ops import pathsim
+from ..ops import planner
 from ..utils.logging import runtime_event
 from .cache import HotTileCache, ResultCache, graph_fingerprint
 from .coalescer import BatchStats, Coalescer, Request
+
+# Lane prefix of secondary-metapath dispatches: the coalescer never
+# mixes lanes in one batch, so each metapath's queries pad into their
+# own batched GEMM against that metapath's engine (the "new coalescer
+# lane axis" of the metapath workload design, DESIGN.md §28).
+_MP_LANE = "mp:"
+
+
+@dataclasses.dataclass
+class MetapathEngine:
+    """One lazily-built secondary-metapath serving engine: a warm
+    backend for a non-default metapath, sharing the service's
+    sub-chain memo, caches, and coalescer. ``fallback_from`` records a
+    backend-class degrade (e.g. an asymmetric chain on jax-sparse
+    serving through numpy) — results are bit-identical either way."""
+
+    metapath: object
+    backend: PathSimBackend
+    d: np.ndarray  # f64 denominators, prefetched like the primary's
+    n: int
+    fallback_from: str | None = None
 
 
 @dataclasses.dataclass
@@ -86,6 +108,14 @@ class ServeConfig:
     # patch update (stale rows answer exactly in the meantime either
     # way); off = refresh only via the refresh_index op/method.
     ann_auto_refresh: bool = True
+    # -- multi-metapath workload (ops/planner.py, DESIGN.md §28) -------
+    # Sub-chain memo budget shared by every metapath engine (None →
+    # the tuned ``plan_memo_budget_mb`` knob; 0 disables memoization).
+    memo_budget_mb: float | None = None
+    # Bound on lazily-built secondary metapath engines: each holds a
+    # warm backend (device factor + compiled buckets), so the set must
+    # not grow with attacker-chosen request fields.
+    max_metapaths: int = 8
 
 
 class PathSimService:
@@ -113,9 +143,14 @@ class PathSimService:
         # class and pass-through options; build_service installs a
         # factory that replays the full RunConfig knobs (dtype,
         # tile_rows, …).
+        # (the default factory threads the sub-chain memo into the
+        # rebuild so a delta-fallback refold hits the still-valid
+        # entries; build_service installs its own memo-threading
+        # factory for the RunConfig path)
         self._backend_factory = backend_factory or (
             lambda hin: type(self.backend)(
-                hin, self.metapath, **self.backend.options
+                hin, self.metapath,
+                **{**self.backend.options, "subchain_memo": self.memo},
             )
         )
         self._update_stats = {"deltas": 0, "rebuilds": 0, "purged_rows": 0}
@@ -145,6 +180,27 @@ class PathSimService:
             )
         self._ann = None  # AnnState once _setup_ann builds/loads one
         self._ann_refresh_inflight = False  # background-refresh debounce
+        # Workload-level sub-chain memo + lazily-built per-metapath
+        # engines (per-request ``metapath`` field). Built BEFORE the
+        # backend install so a rebuild-time engine flush finds them.
+        n0 = backend.hin.type_size(backend.metapath.source_type)
+        budget = (
+            planner.default_memo_budget_bytes(n0)
+            if self.config.memo_budget_mb is None
+            else int(self.config.memo_budget_mb * (1 << 20))
+        )
+        self.memo = planner.SubchainCache(budget) if budget > 0 else None
+        # _engines is read on coalescer threads mid-dispatch, where
+        # taking _swap_lock would deadlock against update()'s
+        # hold-and-drain — so the dict gets its own LEAF lock (never
+        # held across another acquisition; builds still serialize
+        # under _swap_lock, only the dict ops take this one).
+        self._engines_lock = threading.Lock()
+        self._engines: dict[str, MetapathEngine] = {}
+        self._m_engines = get_registry().counter(
+            "dpathsim_plan_engines_total",
+            "secondary metapath engines built, by metapath",
+        )
         self._install_backend(backend, warm=self.config.warm)
         self.coalescer = Coalescer(
             issue=self._issue,
@@ -165,6 +221,11 @@ class PathSimService:
         self.backend = backend
         self.hin = backend.hin
         self.metapath = backend.metapath
+        # Secondary engines bind the OLD hin/backend generation: drop
+        # them (they rebuild lazily against the new graph, re-hitting
+        # the sub-chain memo for factors whose content didn't change).
+        with self._engines_lock:
+            self._engines.clear()
         self.node_type = backend.metapath.source_type
         self.index = self.hin.indices[self.node_type]
         self.n = self.index.size
@@ -348,6 +409,105 @@ class PathSimService:
             int(self._row_ver[row]),
         )
 
+    # -- secondary metapath engines (per-request ``metapath`` field) -------
+
+    def _canon_metapath(self, metapath: str | None) -> str:
+        """Per-request metapath name → canonical name (None → the
+        service default). Cheap; full validation happens at engine
+        build."""
+        if metapath is None:
+            return self.metapath.name
+        name = str(metapath).strip()
+        if not name:
+            return self.metapath.name
+        return name
+
+    def _mp_epoch(self, name: str) -> tuple:
+        """Cache-identity prefix of a secondary metapath's entries:
+        the CHAINED fingerprint (not the base) — any delta advances it,
+        so secondary answers invalidate wholesale per delta while the
+        primary keeps its row-granular story. Coarse but sound: the
+        affected-row analysis is derived per half-chain, and secondary
+        engines rebuild lazily anyway."""
+        return (self._fp, name, self.variant)
+
+    def _engine_for(self, name: str) -> MetapathEngine:
+        """Get or lazily build the serving engine for a non-default
+        metapath. Caller holds ``_swap_lock`` (engine builds must not
+        interleave with a backend swap) — so a FIRST build of a new
+        metapath blocks admissions for its backend-init + warmup, the
+        same stall discipline a reload already has. Post-delta
+        rebuilds are cheap by design: the refold hits the sub-chain
+        memo (measured ~90x warm vs cold) and the warmup re-dispatches
+        already-compiled executables. The engine shares the service's
+        sub-chain memo, so concurrent metapath lanes share common
+        sub-chain folds (APVPA/APA/APTPA all reuse the A·P factor)."""
+        with self._engines_lock:
+            eng = self._engines.get(name)
+            n_engines = len(self._engines)
+        if eng is not None:
+            return eng
+        if n_engines >= self.config.max_metapaths:
+            raise ValueError(
+                f"metapath engine limit ({self.config.max_metapaths}) "
+                "reached; raise --max-metapaths or restart with the "
+                "needed default"
+            )
+        from ..backends.base import create_backend
+        from ..ops.metapath import compile_metapath
+
+        t0 = time.perf_counter()
+        mp = compile_metapath(name, self.hin.schema)
+        if mp.source_type != mp.target_type:
+            raise ValueError(
+                f"metapath {name!r} is not closed "
+                f"({mp.source_type!r} → {mp.target_type!r}); serving "
+                "scores rows of the source type against itself, so a "
+                "served metapath must start and end on one type"
+            )
+        options = dict(self.backend.options)
+        options["subchain_memo"] = self.memo
+        fallback_from = None
+        try:
+            backend = create_backend(
+                self.backend.name, self.hin, mp, **options
+            )
+        except ValueError as exc:
+            # e.g. an asymmetric-but-closed chain on jax-sparse /
+            # jax-sharded: degrade to the numpy oracle for THIS engine
+            # only — bit-identical results, only slower.
+            fallback_from = self.backend.name
+            runtime_event(
+                "metapath_engine_fallback", metapath=name,
+                from_=self.backend.name, to="numpy", reason=str(exc),
+            )
+            backend = create_backend(
+                "numpy", self.hin, mp, subchain_memo=self.memo
+            )
+        if self.config.warm:
+            from ..utils.xla_flags import warm_compile_cache
+
+            warm_compile_cache(
+                backend, self._bucket_ladder,
+                k=self.config.k_default, variant=self.variant,
+            )
+        d = np.asarray(backend._denominators(self.variant), dtype=np.float64)
+        eng = MetapathEngine(
+            metapath=mp, backend=backend, d=d, n=backend.n_sources,
+            fallback_from=fallback_from,
+        )
+        with self._engines_lock:
+            self._engines[name] = eng
+        self._m_engines.inc(metapath=name)
+        runtime_event(
+            "metapath_engine_ready",
+            metapath=name, backend=backend.name, n=eng.n,
+            order=backend.plan.order(),
+            est_flops=round(float(backend.plan.est_flops), 1),
+            startup_s=round(time.perf_counter() - t0, 3),
+        )
+        return eng
+
     # -- dispatch plumbing (runs on coalescer threads) ---------------------
 
     def _issue(self, rows_padded: np.ndarray, k: int, lane: str = "exact"):
@@ -370,6 +530,19 @@ class PathSimService:
             return self._ann.index.probe_batch_device(
                 rows_padded, self._ann.nprobe
             )
+        if lane.startswith(_MP_LANE):
+            # secondary-metapath lane: same batched-counts contract,
+            # against that metapath's engine (present by construction —
+            # submit built it under the swap lock, and update/reload
+            # drain the pipeline before dropping engines)
+            with self._engines_lock:
+                eng = self._engines[lane[len(_MP_LANE):]]
+            issue_device = getattr(eng.backend, "pairwise_rows_device", None)
+            if issue_device is not None:
+                handle = issue_device(rows_padded)
+                if handle is not None:
+                    return handle
+            return eng.backend.pairwise_rows(rows_padded)
         issue_device = getattr(self.backend, "pairwise_rows_device", None)
         if issue_device is not None:
             handle = issue_device(rows_padded)
@@ -457,6 +630,10 @@ class PathSimService:
         activated its context on this thread before calling."""
         if lane == "ann":
             return self._complete_ann(handle, rows, batch)
+        if lane.startswith(_MP_LANE):
+            return self._complete_metapath(
+                handle, rows, batch, k, lane[len(_MP_LANE):]
+            )
         tracer = get_tracer()
         with tracer.child_span("serve.host_transfer", n=int(rows.shape[0])):
             # column trim to the logical width: device handles from a
@@ -497,6 +674,48 @@ class PathSimService:
                 )
                 tracer.finish(req.span, outcome="dispatch")
 
+    def _complete_metapath(
+        self,
+        handle,
+        rows: np.ndarray,
+        batch: Sequence[Request],
+        k: int,
+        name: str,
+    ) -> None:
+        """Completion half of a secondary-metapath batch: the primary
+        path's arithmetic (f64 normalize, oracle tie order, both cache
+        tiers) against the engine's counts/denominators and the
+        metapath's own cache epoch."""
+        with self._engines_lock:
+            eng = self._engines[name]
+        tracer = get_tracer()
+        with tracer.child_span(
+            "serve.host_transfer", n=int(rows.shape[0]), metapath=name
+        ):
+            counts = np.asarray(handle, dtype=np.float64)[
+                : rows.shape[0], : eng.n
+            ]
+        scores = pathsim.score_rows(counts, eng.d[rows], eng.d, xp=np)
+        masked = scores.copy()
+        masked[np.arange(rows.shape[0]), rows] = -np.inf
+        k_eff = min(k, max(eng.n - 1, 1))
+        vals, idxs = pathsim.topk_from_score_rows(masked, k_eff)
+        epoch = self._mp_epoch(name)
+        with tracer.child_span("serve.cache_fill", n=len(batch)):
+            for b, req in enumerate(batch):
+                self.tile_cache.put_row(
+                    epoch, int(rows[b]), scores[b].copy()
+                )
+                kr = min(req.k, k_eff)
+                rv, ri = vals[b, :kr], idxs[b, :kr]
+                self.result_cache.put((*epoch, int(rows[b]), req.k), rv, ri)
+                if not req.future.done():
+                    req.future.set_result((rv, ri))
+                self._m_latency["dispatch"].observe(
+                    time.monotonic() - (req.t_submit or req.t_enqueue)
+                )
+                tracer.finish(req.span, outcome="dispatch")
+
     def _record_batch(self, stats: BatchStats) -> None:
         self._bucket_hist[stats.bucket] = (
             self._bucket_hist.get(stats.bucket, 0) + 1
@@ -515,14 +734,31 @@ class PathSimService:
 
     def resolve(self, source: str | None = None,
                 source_id: str | None = None,
-                row: int | None = None) -> int:
-        """Label / node-id / raw row → dense row index."""
+                row: int | None = None,
+                metapath: str | None = None) -> int:
+        """Label / node-id / raw row → dense row index (in the
+        requested metapath's SOURCE type space — a per-request
+        ``metapath`` may start on a different node type than the
+        service default)."""
+        name = self._canon_metapath(metapath)
+        if name == self.metapath.name:
+            node_type, n = self.node_type, self.n
+        else:
+            # compile only: source type and row bound need the SPEC,
+            # not an engine — building one here would stall admissions
+            # (and burn an engine slot) just to range-check a row
+            from ..ops.metapath import compile_metapath
+
+            node_type = compile_metapath(
+                name, self.hin.schema
+            ).source_type
+            n = self.hin.type_size(node_type)
         if row is not None:
-            if not 0 <= int(row) < self.n:
-                raise KeyError(f"row {row} out of range [0, {self.n})")
+            if not 0 <= int(row) < n:
+                raise KeyError(f"row {row} out of range [0, {n})")
             return int(row)
         return self.hin.resolve_source(
-            self.node_type, label=source, node_id=source_id
+            node_type, label=source, node_id=source_id
         )
 
     def _resolve_mode(self, mode: str | None) -> str:
@@ -560,7 +796,8 @@ class PathSimService:
                 self._ann.nprobe, self._ann.cand_mult, int(row), int(k))
 
     def submit_topk(self, row: int, k: int | None = None,
-                    mode: str | None = None) -> Future:
+                    mode: str | None = None,
+                    metapath: str | None = None) -> Future:
         """Admit a top-k query; returns a Future of (values, indices).
         Cache hits resolve immediately; misses ride the coalescer.
         Raises :class:`coalescer.LoadShedError` at the queue bound.
@@ -579,17 +816,65 @@ class PathSimService:
         trace (enqueue → dispatch → device → transfer → cache fill)."""
         k = int(k or self.config.k_default)
         mode = self._resolve_mode(mode)
+        name = self._canon_metapath(metapath)
         tracer = get_tracer()
         root = tracer.start_span(
-            "serve.request", row=int(row), k=k, mode=mode
+            "serve.request", row=int(row), k=k, mode=mode, metapath=name
         )
         t0 = time.monotonic()
         try:
             with self._swap_lock:
+                if name != self.metapath.name:
+                    if mode == "ann":
+                        # the candidate index embeds the DEFAULT
+                        # metapath's geometry; other chains answer
+                        # exactly (counted like every other fallback)
+                        get_registry().counter(
+                            "dpathsim_ann_fallbacks_total",
+                            "ann-requested queries answered exactly "
+                            "instead, by reason",
+                        ).inc(reason="metapath")
+                    return self._submit_metapath_locked(
+                        int(row), k, name, root, t0
+                    )
                 return self._submit_topk_locked(int(row), k, root, t0, mode)
         except BaseException as exc:
             tracer.finish(root, outcome=type(exc).__name__)
             raise
+
+    def _submit_metapath_locked(self, row: int, k: int, name: str,
+                                root=None, t0: float = 0.0) -> Future:
+        """Secondary-metapath admission (under ``_swap_lock``): same
+        three tiers as the primary path — result LRU, hot-tile
+        re-select, coalesced dispatch on the metapath's own lane."""
+        tracer = get_tracer()
+        eng = self._engine_for(name)
+        if not 0 <= row < eng.n:
+            raise KeyError(f"row {row} out of range [0, {eng.n})")
+        epoch = self._mp_epoch(name)
+        key = (*epoch, int(row), k)
+        hit = self.result_cache.get(key)
+        if hit is not None:
+            fut: Future = Future()
+            fut.set_result(hit)
+            self._m_latency["hit_result"].observe(time.monotonic() - t0)
+            tracer.finish(root, outcome="hit_result")
+            return fut
+        srow = self.tile_cache.get_row(epoch, int(row))
+        if srow is not None:
+            masked = srow.copy()
+            masked[int(row)] = -np.inf
+            k_eff = min(k, max(eng.n - 1, 1))
+            vals, idxs = pathsim.topk_from_score_rows(masked[None, :], k_eff)
+            self.result_cache.put(key, vals[0], idxs[0])
+            fut = Future()
+            fut.set_result((vals[0], idxs[0]))
+            self._m_latency["hit_tile"].observe(time.monotonic() - t0)
+            tracer.finish(root, outcome="hit_tile")
+            return fut
+        return self.coalescer.submit(
+            int(row), k, span=root, t_submit=t0, lane=f"{_MP_LANE}{name}"
+        )
 
     def _submit_topk_locked(self, row: int, k: int, root=None,
                             t0: float = 0.0, mode: str = "exact") -> Future:
@@ -647,7 +932,8 @@ class PathSimService:
 
     def topk_index(self, row: int, k: int | None = None,
                    timeout_s: float | None = None,
-                   mode: str | None = None):
+                   mode: str | None = None,
+                   metapath: str | None = None):
         """Synchronous top-k by dense row index → (values, indices).
         ``timeout_s`` caps the wait below the service-wide default —
         the protocol's ``deadline_ms`` budget lands here, so a request
@@ -655,35 +941,77 @@ class PathSimService:
         timeout = self.config.request_timeout_s
         if timeout_s is not None:
             timeout = min(timeout, max(timeout_s, 0.0))
-        return self.submit_topk(row, k, mode=mode).result(timeout=timeout)
+        return self.submit_topk(
+            row, k, mode=mode, metapath=metapath
+        ).result(timeout=timeout)
 
-    def _ident(self, i: int) -> tuple[str, str]:
+    def _ident(self, i: int, node_type: str | None = None) -> tuple[str, str]:
         """(id, label) for a dense index — huge synthetic graphs carry
         implicit range ids (TypeIndex.size_override, no string tables),
         so serving must synthesize the canonical name rather than index
         an empty tuple."""
-        if i < len(self.index.ids):
-            return self.index.ids[i], self.index.labels[i]
-        return f"{self.node_type}_{i}", f"{self.node_type}_{i}"
+        node_type = node_type or self.node_type
+        idx = self.hin.indices[node_type]
+        if i < len(idx.ids):
+            return idx.ids[i], idx.labels[i]
+        return f"{node_type}_{i}", f"{node_type}_{i}"
 
     def topk(self, source: str | None = None, source_id: str | None = None,
              row: int | None = None, k: int | None = None,
-             timeout_s: float | None = None, mode: str | None = None):
+             timeout_s: float | None = None, mode: str | None = None,
+             metapath: str | None = None):
         """Synchronous top-k by label / id / row, resolved to ids:
-        list of (target_id, target_label, score)."""
-        r = self.resolve(source=source, source_id=source_id, row=row)
-        vals, idxs = self.topk_index(r, k, timeout_s=timeout_s, mode=mode)
+        list of (target_id, target_label, score). ``metapath``
+        overrides the served chain per request (default: the service's
+        ``--metapath``)."""
+        name = self._canon_metapath(metapath)
+        # node_type is captured BEFORE dispatch: an update()/reload
+        # racing the request may drop the engine dict entry after the
+        # future resolves, and a successfully-answered query must not
+        # crash on the id-mapping step
+        if name == self.metapath.name:
+            node_type = self.node_type
+        else:
+            from ..ops.metapath import compile_metapath
+
+            node_type = compile_metapath(name, self.hin.schema).source_type
+        r = self.resolve(
+            source=source, source_id=source_id, row=row, metapath=name
+        )
+        vals, idxs = self.topk_index(
+            r, k, timeout_s=timeout_s, mode=mode, metapath=name
+        )
         return [
-            (*self._ident(int(i)), float(v))
+            (*self._ident(int(i), node_type), float(v))
             for v, i in zip(vals, idxs)
             if np.isfinite(v)
         ]
 
-    def scores_index(self, row: int) -> np.ndarray:
+    def scores_index(self, row: int, metapath: str | None = None) -> np.ndarray:
         """Full normalized score row (self pair included, as the
         driver's all-pairs row would have it). Tile-cache hit or one
         coalesced dispatch."""
         row = int(row)
+        name = self._canon_metapath(metapath)
+        if name != self.metapath.name:
+            with self._swap_lock:
+                self._engine_for(name)
+            srow = self.tile_cache.get_row(self._mp_epoch(name), row)
+            if srow is not None:
+                return srow.copy()
+            self.topk_index(row, self.config.k_default, metapath=name)
+            # re-fetch engine AND epoch: a delta racing the dispatch
+            # advanced _fp and dropped the engine — reading the
+            # pre-dispatch snapshot here would serve pre-delta scores
+            # as the current answer
+            with self._swap_lock:
+                eng = self._engine_for(name)
+            srow = self.tile_cache.get_row(self._mp_epoch(name), row)
+            if srow is not None:
+                return srow.copy()
+            return eng.backend.scores_rows(
+                np.asarray([row]), variant=self.variant
+            )[0]
         # copies on the hit paths: callers mutate score rows (self-
         # masking is the natural first move), and handing out the
         # cache's own array would poison every later tier-2 hit
@@ -703,10 +1031,16 @@ class PathSimService:
 
     # -- lifecycle ---------------------------------------------------------
 
-    def invalidate(self) -> None:
-        """Drop both cache tiers (explicit operator action or reload)."""
+    def invalidate(self, memo: bool = True) -> None:
+        """Drop both cache tiers (explicit operator action or reload).
+        ``memo=False`` keeps the sub-chain memo — update()'s rebuild
+        path uses it after SELECTIVELY invalidating the changed
+        factors, so the rebuild's refold still hits the entries whose
+        content did not move."""
         self.result_cache.clear()
         self.tile_cache.clear()
+        if memo and self.memo is not None:
+            self.memo.clear()
         runtime_event("serve_invalidate", fingerprint=self._fp)
 
     @property
@@ -791,13 +1125,30 @@ class PathSimService:
                     mode, reason = "rebuild", str(exc)
             else:
                 mode = "rebuild"
+            # Sub-chain memo: drop exactly the entries whose factors
+            # changed (keys are content fingerprints, so untouched
+            # sub-chains keep hitting across the delta); secondary
+            # engines bind the pre-delta graph and rebuild lazily.
+            changed_rels = sorted({e.relationship for e in delta.edges})
+            memo_dropped = (
+                self.memo.invalidate_relationships(changed_rels)
+                if self.memo is not None else 0
+            )
+            with self._engines_lock:
+                engines_dropped = len(self._engines)
+                self._engines.clear()
             affected_list: list[int] | None = None
             if mode == "rebuild":
                 self._install_backend(
                     self._backend_factory(plan.hin_new),
                     warm=self.config.warm,
                 )
-                self.invalidate()
+                # answer caches go wholesale; the sub-chain memo was
+                # already SELECTIVELY invalidated above — its surviving
+                # entries are content-addressed (still bit-valid for
+                # untouched factors), and the rebuild's refold just
+                # hit them through the factory's threaded memo
+                self.invalidate(memo=False)
                 self._update_stats["rebuilds"] += 1
                 affected_n, purged = self.n, -1  # everything went
             else:
@@ -854,6 +1205,8 @@ class PathSimService:
                 "node_appends": plan.delta.n_node_appends,
                 "affected_rows": affected_n,
                 "purged_entries": purged,
+                "memo_invalidated": memo_dropped,
+                "engines_dropped": engines_dropped,
                 "delta_seq": self._delta_seq,
                 "base_fp": self._base_fp,
                 "fingerprint": self._fp,
@@ -1050,6 +1403,19 @@ class PathSimService:
                 to_fingerprint=self._fp,
             )
 
+    def _engine_summaries(self) -> dict:
+        with self._engines_lock:
+            engines = sorted(self._engines.items())
+        return {
+            name: {
+                "backend": eng.backend.name,
+                "n": eng.n,
+                "fallback_from": eng.fallback_from,
+                **eng.backend.plan.summary(),
+            }
+            for name, eng in engines
+        }
+
     def stats(self) -> dict:
         c = self.coalescer
         batches = max(c.batch_count, 1)
@@ -1084,6 +1450,18 @@ class PathSimService:
             "variant": self.variant,
             "backend": self.backend.name,
             "fingerprint": self._fp,
+            # Planner visibility (DESIGN.md §28): the primary plan's
+            # chosen association order + cost estimates, every live
+            # secondary engine's, and the sub-chain memo accounting —
+            # stats() answers "what did the planner decide and is the
+            # memo earning its bytes" without log replay.
+            "plan": {
+                "primary": self.backend.plan.summary(),
+                "engines": self._engine_summaries(),
+                "memo": (
+                    self.memo.stats() if self.memo is not None else None
+                ),
+            },
             "topk_mode": self.config.topk_mode,
             "ann": self._ann.snapshot() if self._ann is not None else None,
             "delta": {
@@ -1139,7 +1517,12 @@ def build_service(
         config=serve_config,
         # delta-fallback rebuilds replay the full RunConfig knobs
         backend_factory=lambda hin: create_backend(
-            config.backend, hin, metapath, **backend_options(config)
+            config.backend, hin, metapath,
+            # the service's sub-chain memo rides into rebuilds so a
+            # refold hits the entries the delta did not invalidate
+            # (installed below once the service — and its memo — exist)
+            subchain_memo=service.memo,
+            **backend_options(config),
         ),
     )
     runtime_event(

@@ -286,6 +286,89 @@ def bench_sparse_nnz_floor(point: SweepPoint, reps: int,
     return {"sparse_nnz_floor": (int(br.best_arm(res)[5:]), res)}
 
 
+def bench_planner(point: SweepPoint, reps: int) -> dict:
+    """plan_density_cutover + plan_memo_budget_mb — real arms over a
+    synthetic HIN at the sweep point's scale.
+
+    Cutover arms: plan a 4-factor asymmetric COO chain (APVPT) under
+    each density threshold and time the plan-ordered sparse fold —
+    the threshold decides where the DP switches from the join-size
+    estimate to the dense model, which flips the association order it
+    picks; the measured fold time is the ground truth the estimate
+    stands in for. Memo arms: a rotating mixed APVPA/APA/APTPA fold
+    workload over several graph variants per budget — a small budget
+    thrashes the LRU, a large one keeps every shared sub-chain
+    resident."""
+    from ..data.synthetic import synthetic_hin
+    from ..ops import planner
+    from ..ops import sparse as _sp
+    from ..ops.metapath import compile_metapath
+
+    n = point.n
+    hin = synthetic_hin(
+        n, 2 * n, max(point.v // 4, 4), n_topics=max(point.v // 8, 8),
+        seed=3,
+    )
+    mp = compile_metapath("APVPT", hin.schema)
+    blocks = []
+    for st in mp.steps:
+        b = _sp.coo_from_block(hin.block(st.relationship))
+        if st.reverse:
+            b = _sp.COOMatrix(
+                rows=b.cols, cols=b.rows, weights=b.weights,
+                shape=(b.shape[1], b.shape[0]),
+            )
+        blocks.append(b.summed())
+    arms = {}
+    for cut in KNOBS["plan_density_cutover"].candidates(
+        {"n": n, "v": len(mp.steps)}
+    ):
+
+        def fn(cut=cut):
+            out = planner.fold_blocks(blocks, dense_cutover=float(cut))
+            return int(out.rows.shape[0])
+
+        arms[f"cut{cut}"] = fn
+    res = br.time_interleaved(arms, reps)
+    out = {
+        "plan_density_cutover": (
+            float(br.best_arm(res).removeprefix("cut")), res
+        ),
+    }
+
+    variants = [
+        synthetic_hin(
+            n, 2 * n, max(point.v // 4, 4),
+            n_topics=max(point.v // 8, 8), seed=11 + s,
+        )
+        for s in range(4)
+    ]
+    paths = [
+        compile_metapath(spec, variants[0].schema)
+        for spec in ("APVPA", "APA", "APTPA")
+    ]
+
+    def memo_arm(mb: float):
+        memo = planner.SubchainCache(int(mb * (1 << 20)))
+
+        def run():
+            for h in variants:
+                for p in paths:
+                    planner.fold_half(h, p, memo=memo)
+
+        return run
+
+    memo_arms = {
+        f"mb{mb}": memo_arm(mb)
+        for mb in KNOBS["plan_memo_budget_mb"].candidates({"n": n})
+    }
+    memo_res = br.time_interleaved(memo_arms, reps)
+    out["plan_memo_budget_mb"] = (
+        float(br.best_arm(memo_res).removeprefix("mb")), memo_res
+    )
+    return out
+
+
 def bench_ring(point: SweepPoint, reps: int, k: int = 10) -> dict:
     """Ring-step fold choice on a 1-device mesh: the same compiled
     shard_map program a real slice runs per step, minus the ICI hop —
@@ -674,6 +757,8 @@ def tune(
                 record(point, bench_ring(point, reps))
             if want & set(_ANN_KNOBS):
                 record(point, bench_ann(point, reps))
+            if want & {"plan_density_cutover", "plan_memo_budget_mb"}:
+                record(point, bench_planner(point, reps))
         else:
             if "sparse_tile_rows" in want:
                 record(point, bench_sparse_tiles(point, reps),

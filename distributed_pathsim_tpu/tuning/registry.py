@@ -155,6 +155,36 @@ KNOBS: dict[str, Knob] = {
             candidates=lambda ctx: [4, 8, 16, 32],
         ),
         Knob(
+            name="plan_density_cutover",
+            doc="metapath planner cost model: intermediate density at "
+            "which a factor is costed as DENSE (2·m·r·n GEMM FLOPs) "
+            "instead of the sparse join estimate (Atrapos density "
+            "propagation). Affects only the plan's ORDER choice — "
+            "integer path counts are association-invariant, so every "
+            "order is bit-identical (the planner property tests gate "
+            "it).",
+            candidates=lambda ctx: [0.05, 0.1, 0.25, 0.5],
+        ),
+        Knob(
+            name="plan_dp_max_len",
+            doc="metapath planner DP size cutoff: chains longer than "
+            "this skip the O(L³) interval DP and evaluate "
+            "left-to-right (recorded on the plan as dp=False). Real "
+            "metapaths are L ≤ 7; the cutoff exists so a pathological "
+            "spec cannot stall plan compilation.",
+            candidates=lambda ctx: [4, 8, 16, 32],
+        ),
+        Knob(
+            name="plan_memo_budget_mb",
+            doc="workload-level sub-chain memo budget (MB): folded "
+            "sub-chain COO factors shared across concurrent metapath "
+            "lanes (ops/planner.SubchainCache). Bigger budgets keep "
+            "more shared prefixes resident across deltas; keys are "
+            "content fingerprints, so the budget trades bytes for "
+            "hit rate, never correctness.",
+            candidates=lambda ctx: [16.0, 64.0, 256.0],
+        ),
+        Knob(
             name="serve_buckets",
             doc="serving bucket-ladder geometry pre-compiled at "
             "warmup: 'pow2' (1,2,4,…; <2x pad waste, log2(B)+1 "
@@ -212,6 +242,13 @@ SANCTIONED_CONSTANTS: dict[str, frozenset[str]] = {
     }),
     "serving/buckets.py": frozenset({
         "DEFAULT_BUCKETS",  # serve_buckets 'pow2' default, documented
+    }),
+    "ops/planner.py": frozenset({
+        "_DEG_BUCKETS",   # degree-histogram resolution (24 log2 buckets
+        # cover any int32 index space) — an audit-layout invariant of
+        # FactorStats, not a measured performance choice; the planner's
+        # real knobs (plan_density_cutover, plan_dp_max_len,
+        # plan_memo_budget_mb) are registry knobs above
     }),
     "obs/metrics.py": frozenset({
         "DEFAULT_BUCKETS_PER_DECADE",  # histogram resolution (quantile

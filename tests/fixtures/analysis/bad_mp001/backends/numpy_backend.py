@@ -1,0 +1,7 @@
+"""BAD: evaluates the chain directly, bypassing the planner."""
+
+from ..ops import chain
+
+
+def commuting_matrix(blocks):
+    return chain.chain_product(blocks)

@@ -1,0 +1,8 @@
+"""Chain-fold primitives (fixture mirror of ops/chain.py)."""
+
+
+def chain_product(blocks, xp=None):
+    m = blocks[0]
+    for b in blocks[1:]:
+        m = m @ b
+    return m

@@ -1,0 +1,7 @@
+"""GOOD: chain evaluation goes through the planner doorway."""
+
+from ..ops import planner
+
+
+def commuting_matrix(plan, blocks):
+    return planner.execute_dense(plan, blocks)

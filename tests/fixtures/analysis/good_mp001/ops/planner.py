@@ -1,0 +1,7 @@
+"""Planner doorway (fixture mirror of ops/planner.py)."""
+
+from . import chain
+
+
+def execute_dense(plan, blocks, xp=None):
+    return chain.chain_product(blocks, xp=xp)

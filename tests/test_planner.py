@@ -1,0 +1,436 @@
+"""The metapath query engine (ops/planner.py, DESIGN.md §28).
+
+Four layers:
+
+- **Planner unit tests**: the DP picks the cheaper association on a
+  chain where ordering matters, records estimated FLOPs/density on
+  every node, exposes the order string, and falls back (recorded) past
+  the DP size cutoff.
+- **Property tests**: random metapaths (symmetric and asymmetric,
+  length 3–7) × random small HINs — the planner path is bit-identical
+  to the naive left-to-right ``chain_product`` oracle on all four
+  backends, tie order included.
+- **Memoization**: warm sub-chain folds equal cold folds bit-for-bit,
+  concurrent metapath workloads share sub-chains, and random delta
+  sequences invalidate exactly the entries whose factors changed.
+- **Serving**: the per-request ``metapath`` field answers through its
+  own coalescer lane, bit-identical to a dedicated service, and two
+  engines demonstrably share a memoized sub-chain.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from distributed_pathsim_tpu.backends.base import create_backend
+from distributed_pathsim_tpu.data.synthetic import synthetic_hin
+from distributed_pathsim_tpu.ops import chain, planner
+from distributed_pathsim_tpu.ops import sparse as sp
+from distributed_pathsim_tpu.ops.metapath import compile_metapath
+from distributed_pathsim_tpu.ops.planner import (
+    EvalPlan,
+    SubchainCache,
+    factor_stats_from_coo,
+    plan_chain,
+    plan_metapath,
+)
+
+# The type-adjacency walk graph of the synthetic DBLP schema: which
+# letters can follow which (via exactly one relation each — compile
+# stays unambiguous).
+_NEXT = {"A": "P", "P": "AVT", "V": "P", "T": "P"}
+
+
+def _hin(seed: int, n_authors=40, n_papers=70, n_venues=6, n_topics=5):
+    return synthetic_hin(
+        n_authors, n_papers, n_venues, n_topics=n_topics,
+        topics_per_paper=1.4, seed=seed,
+    )
+
+
+def _random_metapath(rng, length: int) -> str:
+    spec = [rng.choice(list("APVT"))]
+    while len(spec) < length:
+        spec.append(rng.choice(list(_NEXT[spec[-1]])))
+    return "".join(spec)
+
+
+def _naive_oracle(hin, mp):
+    """Left-to-right f64 dense fold — the pre-planner reference
+    semantics (exact integer counts below 2^53)."""
+    blocks = chain.oriented_dense_blocks(hin, mp.steps, dtype=np.float64)
+    m = planner.naive_dense(blocks, xp=np)
+    return m, m.sum(axis=1)
+
+
+# ---------------------------------------------------------------------------
+# Planner unit tests
+# ---------------------------------------------------------------------------
+
+
+def _stats(m, n, nnz):
+    rng = np.random.default_rng(7)
+    rows = rng.integers(0, m, size=nnz)
+    cols = rng.integers(0, n, size=nnz)
+    return factor_stats_from_coo(rows, cols, (m, n))
+
+
+def test_dp_beats_left_to_right_when_ordering_matters():
+    # tall·wide·tall (dims 1000, 10, 1000, 10): left-to-right pays the
+    # huge 1000×1000 intermediate; A·(B·C) contracts to 10×10 first.
+    stats = [
+        _stats(1000, 10, 4000),
+        _stats(10, 1000, 4000),
+        _stats(1000, 10, 4000),
+    ]
+    root, naive_flops, dp = plan_chain(
+        stats, dense_cutover=0.25, dp_max_len=16
+    )
+    assert dp
+    assert root.total_flops < naive_flops
+    # every node carries auditable estimates
+    def walk(n):
+        assert n.est_flops >= 0 and 0.0 <= n.est_density <= 1.0
+        if n.left:
+            walk(n.left)
+            walk(n.right)
+    walk(root)
+
+
+def test_dp_size_cutoff_recorded():
+    stats = [_stats(50, 50, 200)] * 5
+    root, _, dp = plan_chain(stats, dense_cutover=0.25, dp_max_len=3)
+    assert not dp  # fell back to left-to-right, recorded on the plan
+    assert root.hi - root.lo == 5
+
+
+def test_plan_metapath_modes_and_audit():
+    hin = _hin(0)
+    sym = plan_metapath(hin, compile_metapath("APVPA", hin.schema))
+    assert sym.mode == "half"
+    assert sym.order()  # parenthesized expression renders
+    d = sym.to_dict()
+    assert d["tree"]["est_flops"] >= 0
+    asym = plan_metapath(hin, compile_metapath("APV", hin.schema))
+    assert asym.mode == "general"
+    assert isinstance(asym, EvalPlan)
+    # plan is memoized per (hin, metapath)
+    again = plan_metapath(hin, compile_metapath("APVPA", hin.schema))
+    assert again is sym
+
+
+def test_fold_half_matches_legacy_shim_and_is_order_invariant():
+    hin = _hin(1)
+    mp = compile_metapath("APVPA", hin.schema)
+    a = planner.fold_half(hin, mp).summed()
+    b = sp.half_chain_coo(hin, mp).summed()  # deprecated shim → planner
+    assert np.array_equal(a.rows, b.rows)
+    assert np.array_equal(a.cols, b.cols)
+    assert np.array_equal(a.weights, b.weights)
+
+
+# ---------------------------------------------------------------------------
+# Property tests: planner ≡ naive left-to-right, all four backends
+# ---------------------------------------------------------------------------
+
+
+def test_random_metapaths_bit_identical_numpy_and_jax():
+    rng = np.random.default_rng(42)
+    seen = set()
+    for trial in range(10):
+        length = int(rng.integers(3, 8))
+        spec = _random_metapath(rng, length)
+        if spec in seen:
+            continue
+        seen.add(spec)
+        hin = _hin(100 + trial)
+        mp = compile_metapath(spec, hin.schema)
+        m_ref, rs_ref = _naive_oracle(hin, mp)
+        for name in ("numpy", "jax"):
+            b = create_backend(name, hin, mp)
+            got_m = np.asarray(b.commuting_matrix(), dtype=np.float64)
+            got_rs = np.asarray(b.global_walks(), dtype=np.float64)
+            n_src = hin.type_size(mp.source_type)
+            n_dst = hin.type_size(mp.target_type)
+            assert np.array_equal(got_m, m_ref[:n_src, :n_dst]), (
+                f"{name} M diverged on {spec} (symmetric="
+                f"{mp.is_symmetric}, plan={b.plan.order()})"
+            )
+            assert np.array_equal(got_rs, rs_ref[:n_src]), (
+                f"{name} rowsums diverged on {spec}"
+            )
+
+
+def test_random_symmetric_metapaths_all_four_backends_topk_ties():
+    rng = np.random.default_rng(7)
+    specs = ["APA", "APVPA", "APTPA", "PVP", "PAP", "PTP"]
+    rng.shuffle(specs)
+    for trial, spec in enumerate(specs[:4]):
+        hin = _hin(200 + trial, n_authors=30, n_papers=50)
+        mp = compile_metapath(spec, hin.schema)
+        assert mp.is_symmetric
+        oracle = create_backend("numpy", hin, mp)
+        rows = np.arange(min(12, oracle.n_sources), dtype=np.int64)
+        want_v, want_i = oracle.topk_rows(rows, k=5)
+        for name in ("jax", "jax-sparse", "jax-sharded"):
+            kwargs = {"n_devices": 2} if name == "jax-sharded" else {}
+            b = create_backend(name, hin, mp, **kwargs)
+            got_v, got_i = b.topk_rows(rows, k=5)
+            # tie order (desc score, asc col) must survive the planner
+            assert np.array_equal(got_i, want_i), f"{name}/{spec} ties"
+            assert np.array_equal(got_v, want_v), f"{name}/{spec} values"
+
+
+def test_asymmetric_pairwise_rows_match_oracle():
+    rng = np.random.default_rng(3)
+    for trial in range(4):
+        spec = _random_metapath(rng, int(rng.integers(3, 6)))
+        hin = _hin(300 + trial)
+        mp = compile_metapath(spec, hin.schema)
+        m_ref, _ = _naive_oracle(hin, mp)
+        b = create_backend("numpy", hin, mp)
+        rows = np.asarray([0, 1, 2], dtype=np.int64)
+        got = b.pairwise_rows(rows)
+        assert np.array_equal(
+            got, m_ref[rows][:, : hin.type_size(mp.target_type)]
+        ), spec
+
+
+# ---------------------------------------------------------------------------
+# Memoization
+# ---------------------------------------------------------------------------
+
+
+def _coo_equal(a, b) -> bool:
+    a, b = a.summed(), b.summed()
+    return (
+        np.array_equal(a.rows, b.rows)
+        and np.array_equal(a.cols, b.cols)
+        and np.array_equal(a.weights, b.weights)
+    )
+
+
+def test_memo_warm_equals_cold_and_shares_subchains():
+    hin = _hin(5)
+    memo = SubchainCache(64 << 20)
+    apvpa = compile_metapath("APVPA", hin.schema)
+    aptpa = compile_metapath("APTPA", hin.schema)
+    cold_apvpa = planner.fold_half(hin, apvpa)
+    warm_apvpa = planner.fold_half(hin, apvpa, memo=memo)
+    assert _coo_equal(cold_apvpa, warm_apvpa)
+    h0 = memo.hits
+    # APTPA's half shares the oriented A·P factor with APVPA's
+    cold_aptpa = planner.fold_half(hin, aptpa)
+    warm_aptpa = planner.fold_half(hin, aptpa, memo=memo)
+    assert _coo_equal(cold_aptpa, warm_aptpa)
+    assert memo.hits > h0, "shared A·P sub-chain should hit"
+    # full re-fold of APVPA is now a pure hit path
+    h1 = memo.hits
+    again = planner.fold_half(hin, apvpa, memo=memo)
+    assert _coo_equal(again, cold_apvpa)
+    assert memo.hits > h1
+
+
+def test_memo_correct_across_random_delta_sequences():
+    from distributed_pathsim_tpu.data.delta import (
+        DeltaBatch,
+        apply_delta,
+        edge_delta,
+    )
+
+    rng = np.random.default_rng(11)
+    hin = _hin(6)
+    memo = SubchainCache(64 << 20)
+    mp = compile_metapath("APVPA", hin.schema)
+    planner.fold_half(hin, mp, memo=memo)  # seed the memo
+    for step in range(4):
+        blk = hin.blocks["author_of"]
+        existing = set(zip(blk.rows.tolist(), blk.cols.tolist()))
+        # one random add + one random remove on author_of
+        adds = []
+        for a in rng.permutation(hin.type_size("author")):
+            p = int(rng.integers(0, hin.type_size("paper")))
+            if (int(a), p) not in existing:
+                adds.append((int(a), p))
+                break
+        j = int(rng.integers(0, blk.rows.shape[0]))
+        removes = [(int(blk.rows[j]), int(blk.cols[j]))]
+        delta = DeltaBatch(
+            edges=(edge_delta("author_of", add=adds, remove=removes),)
+        )
+        hin, grew = apply_delta(hin, delta)
+        assert not grew
+        warm = planner.fold_half(hin, mp, memo=memo)
+        cold = planner.fold_half(hin, mp)
+        assert _coo_equal(warm, cold), f"delta step {step}"
+
+
+def test_memo_invalidation_drops_only_changed_factors():
+    hin = _hin(8)
+    memo = SubchainCache(64 << 20)
+    planner.fold_half(hin, compile_metapath("APVPA", hin.schema), memo=memo)
+    planner.fold_half(hin, compile_metapath("APTPA", hin.schema), memo=memo)
+    before = memo.stats()["entries"]
+    dropped = memo.invalidate_relationships({"submit_at"})
+    # submit_at appears only in APVPA's sub-chains; the A·P leaf and
+    # APTPA's has_topic sub-chains survive
+    assert 0 < dropped < before
+    assert memo.stats()["entries"] == before - dropped
+    assert memo.invalidate_relationships({"no_such_rel"}) == 0
+
+
+def test_memo_budget_evicts_lru_and_skips_oversized():
+    def coo(nnz):
+        return sp.COOMatrix(
+            rows=np.zeros(nnz, dtype=np.int64),
+            cols=np.zeros(nnz, dtype=np.int64),
+            weights=np.ones(nnz), shape=(4, 4),
+        )
+
+    memo = SubchainCache(10_000)
+    for i in range(8):  # 8 × ~2.4 kB under a 10 kB budget: must evict
+        memo.put((("r", False, f"fp{i}"),), coo(100))
+    st = memo.stats()
+    assert st["evictions"] > 0
+    assert st["bytes"] <= 10_000
+    # an entry bigger than half the budget is skipped outright (it
+    # would evict every interior fold just to store one huge leaf)
+    memo.put((("r", False, "big"),), coo(1000))
+    assert memo.get((("r", False, "big"),)) is None
+
+
+# ---------------------------------------------------------------------------
+# Serving: per-request metapath field, lanes, shared memo
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def mp_service():
+    from distributed_pathsim_tpu.serving import PathSimService, ServeConfig
+
+    hin = _hin(21, n_authors=32, n_papers=60)
+    mp = compile_metapath("APVPA", hin.schema)
+    svc = PathSimService(
+        create_backend("numpy", hin, mp),
+        config=ServeConfig(max_wait_ms=1.0, warm=False),
+    )
+    yield hin, svc
+    svc.close()
+
+
+def test_serving_per_request_metapath_bit_identical(mp_service):
+    hin, svc = mp_service
+    for spec in ("APA", "APTPA"):
+        mp2 = compile_metapath(spec, hin.schema)
+        dedicated = create_backend("numpy", hin, mp2)
+        for row in (0, 3, 7):
+            vals, idxs = svc.topk_index(row, k=5, metapath=spec)
+            want_v, want_i = dedicated.topk_row(row, k=5)
+            assert np.array_equal(idxs, want_i), (spec, row)
+            assert np.array_equal(vals, want_v), (spec, row)
+    # engines share the sub-chain memo: the A·P factor crossed lanes
+    st = svc.stats()
+    assert set(st["plan"]["engines"]) == {"APA", "APTPA"}
+    assert st["plan"]["memo"]["hits"] > 0
+    assert st["plan"]["primary"]["metapath"] == "APVPA"
+
+
+def test_serving_default_metapath_unchanged(mp_service):
+    _, svc = mp_service
+    v1, i1 = svc.topk_index(2, k=5)
+    v2, i2 = svc.topk_index(2, k=5, metapath="APVPA")  # explicit default
+    assert np.array_equal(i1, i2) and np.array_equal(v1, v2)
+
+
+def test_serving_metapath_validation(mp_service):
+    _, svc = mp_service
+    with pytest.raises((KeyError, ValueError)):
+        svc.topk_index(0, k=5, metapath="APV")  # not closed
+    with pytest.raises((KeyError, ValueError)):
+        svc.topk_index(0, k=5, metapath="AXA")  # unknown letter
+
+
+def test_serving_scores_and_protocol_metapath(mp_service):
+    from distributed_pathsim_tpu.serving.protocol import handle_request
+
+    hin, svc = mp_service
+    mp2 = compile_metapath("APA", hin.schema)
+    dedicated = create_backend("numpy", hin, mp2)
+    want = dedicated.scores_rows(np.asarray([4]))[0]
+    got = svc.scores_index(4, metapath="APA")
+    assert np.array_equal(got, want)
+    resp = handle_request(
+        svc, {"id": 1, "op": "topk", "row": 4, "k": 3, "metapath": "APA"}
+    )
+    assert resp["ok"], resp
+    want_v, want_i = dedicated.topk_row(4, k=3)
+    got_scores = [h["score"] for h in resp["result"]["topk"]]
+    assert got_scores == [float(v) for v in want_v if np.isfinite(v)]
+    resp = handle_request(
+        svc, {"id": 2, "op": "scores", "row": 4, "metapath": "APA"}
+    )
+    assert resp["ok"] and resp["result"]["row"] == 4
+
+
+def test_serving_update_invalidates_metapath_engines(mp_service):
+    hin, svc = mp_service
+    svc.topk_index(1, k=5, metapath="APA")  # build the engine pre-delta
+    blk = svc.hin.blocks["author_of"]
+    removes = [{
+        "rel": "author_of",
+        "src_row": int(blk.rows[0]), "dst_row": int(blk.cols[0]),
+    }]
+    from distributed_pathsim_tpu.data.delta import delta_from_records
+
+    delta = delta_from_records(svc.hin, remove_edges=removes)
+    result = svc.update(delta)
+    assert result["engines_dropped"] >= 1
+    # post-delta: the APA engine rebuilds lazily and answers from the
+    # new graph, bit-identical to a fresh dedicated backend
+    mp2 = compile_metapath("APA", svc.hin.schema)
+    dedicated = create_backend("numpy", svc.hin, mp2)
+    want_v, want_i = dedicated.topk_row(1, k=5)
+    got_v, got_i = svc.topk_index(1, k=5, metapath="APA")
+    assert np.array_equal(got_i, want_i)
+    assert np.array_equal(got_v, want_v)
+
+
+def test_serving_metapath_lane_coalesces_concurrently(mp_service):
+    """Concurrent mixed-metapath submits: each lane forms its own
+    batches (no cross-metapath mixing) and every future resolves to
+    the right answer."""
+    hin, svc = mp_service
+    oracles = {
+        spec: create_backend(
+            "numpy", hin, compile_metapath(spec, hin.schema)
+        )
+        for spec in ("APVPA", "APA", "APTPA")
+    }
+    futs = []
+    for i in range(24):
+        spec = ("APVPA", "APA", "APTPA")[i % 3]
+        row = i % 8
+        futs.append((spec, row, svc.submit_topk(row, 4, metapath=spec)))
+    for spec, row, fut in futs:
+        vals, idxs = fut.result(timeout=30)
+        want_v, want_i = oracles[spec].topk_row(row, k=4)
+        assert np.array_equal(idxs, want_i), (spec, row)
+        assert np.array_equal(vals, want_v), (spec, row)
+
+
+# ---------------------------------------------------------------------------
+# Bench smoke (tier-1 wiring of `make metapath-smoke`)
+# ---------------------------------------------------------------------------
+
+
+def test_bench_metapath_smoke(tmp_path):
+    import bench_serving
+
+    out = str(tmp_path / "metapath_smoke.json")
+    result = bench_serving.run_metapath_smoke(out_path=out)
+    assert result["checks"]["planner_beats_naive_measured"]
+    assert result["checks"]["planner_beats_naive_estimated"]
+    assert result["checks"]["memo_subchain_shared_across_lanes"]
+    assert result["checks"]["mixed_lanes_bit_identical"]
+    assert result["checks"]["zero_steady_state_recompiles"]

@@ -182,7 +182,14 @@ def test_observed_wire_traffic_is_covered_by_schema(recorder):
         assert router.request({"op": "topk", "row": 3, "k": 5})["ok"]
         assert router.request({"op": "topk", "source_id": sid,
                                "k": 4})["ok"]
+        # the per-request metapath override (DESIGN.md §28): the field
+        # must cross the wire live so the inference soundness gate
+        # covers its removal
+        assert router.request({"op": "topk", "row": 3, "k": 4,
+                               "metapath": "APA"})["ok"]
         assert router.request({"op": "scores", "row": 3})["ok"]
+        assert router.request({"op": "scores", "row": 3,
+                               "metapath": "APA"})["ok"]
         assert router.request({
             "op": "update", "add_edges": adds, "remove_edges": removes,
         })["ok"]
