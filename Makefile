@@ -5,8 +5,8 @@ PYTHON ?= python
 
 .PHONY: test chaos chaos-router serve-smoke update-smoke obs-smoke \
 	router-smoke partition-smoke ann-smoke fleet-obs-smoke \
-	metapath-smoke lint lint-schema lint-telemetry tune-smoke \
-	lint-tuning tune
+	metapath-smoke compress-smoke lint lint-schema lint-telemetry \
+	tune-smoke lint-tuning tune
 
 # Tier-1: the fast CPU suite (the driver's acceptance gate).
 test:
@@ -50,6 +50,18 @@ router-smoke:
 # covers it.
 partition-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) bench_serving.py --regime partition --smoke
+
+# Compressed-factors smoke: one jax-sparse backend per resident factor
+# layout (coo / blocked / bitpacked) over the same seeded workload —
+# gates >=1.5x measured resident factor-bytes reduction, bit-identical
+# counts/scores/top-k ties vs the COO arm through a delta-interleaved
+# run, zero steady-state recompiles, and a strictly higher modeled
+# max-N-at-budget single-chip AND per-partition. Also wired non-slow
+# into tier-1 via pytest
+# (tests/test_compress.py::test_bench_compress_smoke), so tier-1
+# covers it.
+compress-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) bench_serving.py --regime compress --smoke
 
 # Serving smoke: the closed-loop load generator on a small fixed-seed
 # synthetic graph, with hard gates (warm-cache p50 < cold-cache p50,

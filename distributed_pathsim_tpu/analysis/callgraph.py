@@ -281,7 +281,14 @@ class CallGraph:
         return None
 
     def call_sites(self) -> list[CallSite]:
-        """Every call in every function, in deterministic order."""
+        """Every call in every function, in deterministic order.
+        Memoized on the instance: the walk+resolve is a real fraction
+        of the tier-1 lint budget and the graph is immutable after
+        construction, so every interprocedural pass sharing this graph
+        shares one walk."""
+        cached = getattr(self, "_call_sites_cache", None)
+        if cached is not None:
+            return cached
         out: list[CallSite] = []
         for fid in sorted(self.by_fid):
             fn = self.by_fid[fid]
@@ -293,6 +300,7 @@ class CallGraph:
                         callee=self.resolve(fn, node, lt),
                         node=node,
                     ))
+        self._call_sites_cache = out
         return out
 
     def functions_named(
@@ -429,6 +437,28 @@ def dotted_tail(node: ast.AST) -> str | None:
         parts.append(node.attr)
         node = node.value
     return ".".join(reversed(parts)) if parts else None
+
+
+# One package-view CallGraph per analysis run: the interprocedural
+# passes over `root_kind == "package"` (wire schema WC101+, MP001,
+# CF001) all consume the IDENTICAL graph, and building it (plus the
+# call-site walk) once per pass was a real fraction of the tier-1
+# lint time budget. Keyed by the module objects' identities — safe
+# because the cached graph holds the modules strongly, so their ids
+# cannot be reused while the entry is alive; a new run parses new
+# Module objects and misses.
+_PKG_GRAPH_CACHE: tuple[tuple[int, ...], CallGraph] | None = None
+
+
+def shared_package_graph(modules: list[Module]) -> CallGraph:
+    global _PKG_GRAPH_CACHE
+    pkg = [m for m in modules if m.root_kind == "package"]
+    key = tuple(id(m) for m in pkg)
+    if _PKG_GRAPH_CACHE is not None and _PKG_GRAPH_CACHE[0] == key:
+        return _PKG_GRAPH_CACHE[1]
+    graph = CallGraph(pkg)
+    _PKG_GRAPH_CACHE = (key, graph)
+    return graph
 
 
 __all__ = [

@@ -30,7 +30,7 @@ O(Δ) patches, which is incremental maintenance, not evaluation.
 
 from __future__ import annotations
 
-from .callgraph import CallGraph, propagate_reachability
+from .callgraph import propagate_reachability, shared_package_graph
 from .core import Finding, Module
 
 RULE_DOCS = {
@@ -67,8 +67,7 @@ class MetapathIRPass:
     rules = RULE_DOCS
 
     def run(self, modules: list[Module]) -> list[Finding]:
-        pkg = [m for m in modules if m.root_kind == "package"]
-        graph = CallGraph(pkg)
+        graph = shared_package_graph(modules)
         seeds: dict[str, str] = {}
         for fid in sorted(graph.by_fid):
             fn = graph.by_fid[fid]

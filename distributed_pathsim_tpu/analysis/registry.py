@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import dataclasses
 
+from .compress_rules import CompressedLayoutPass
 from .determinism import DeterminismPass
 from .exceptions import ExceptionSafetyPass
 from .interlocks import InterLockPass
@@ -47,6 +48,8 @@ PASS_FAMILIES: dict[str, str] = {
     "PartitionOwnershipPass": "partition ownership (PT)",
     "ExceptionSafetyPass": "exception safety / exactly-once (EX)",
     "MetapathIRPass": "metapath planner IR, interprocedural (MP)",
+    "CompressedLayoutPass": "compressed factor layouts, "
+                            "interprocedural (CF)",
 }
 
 ALL_PASSES = (
@@ -61,6 +64,7 @@ ALL_PASSES = (
     PartitionOwnershipPass(),
     ExceptionSafetyPass(),
     MetapathIRPass(),
+    CompressedLayoutPass(),
 )
 
 RULES: dict[str, RuleDoc] = {}

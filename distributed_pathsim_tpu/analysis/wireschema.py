@@ -137,9 +137,11 @@ class _OpSchema:
 
 class _SchemaBuilder:
     def __init__(self, modules: list[Module]):
-        self.modules = [m for m in modules if m.root_kind == "package"]
+        from .callgraph import shared_package_graph
+
+        self.graph = shared_package_graph(modules)
+        self.modules = self.graph.modules
         self.by_rel = {m.rel: m for m in self.modules}
-        self.graph = CallGraph(self.modules)
         self.consts = {
             m.repo_rel: _const_tuples(m.tree) for m in self.modules
         }

@@ -68,6 +68,15 @@ class PathSimBackend(abc.ABC):
         estimates (the ``stats()``/bench surface of plan choices)."""
         return self.plan.to_dict()
 
+    def factor_info(self) -> dict | None:
+        """Resident factor accounting for the memory-headroom surface
+        (``stats()["factor"]`` + the ``dpathsim_factor_bytes`` gauge):
+        ``{"format", "bytes", "nnz", "coo_bytes"}``, or None for
+        backends with no resident sparse factor. ``coo_bytes`` is the
+        24-byte/nnz uncompressed equivalent, so the reduction ratio is
+        readable straight off the stats block."""
+        return None
+
     @property
     def n_sources(self) -> int:
         """Logical source-node count (never the padded capacity).

@@ -42,6 +42,7 @@ class JaxSparseBackend(PathSimBackend):
         exact_counts: bool = True,
         dense_c_budget_bytes: int | None = None,
         rect_kernel: bool | None = None,
+        factor_format: str | None = None,
         **options,
     ):
         """``exact_counts=True`` (default) delivers EXACT integer counts
@@ -107,18 +108,61 @@ class JaxSparseBackend(PathSimBackend):
                 default=1,
             )
         )
+        # Resident factor layout (DESIGN.md §29): resolved ONCE at
+        # build through the tuning registry (never a silent default —
+        # the heuristic default IS "coo", the uncompressed layout every
+        # release before this one shipped) and pinned: a delta rebind
+        # must patch the SAME representation, not re-decide it.
+        from ..ops import packed as pkd
+
+        if factor_format is None:
+            factor_format = str(
+                tuning.choose(
+                    "factor_format",
+                    n=coo.shape[0], v=coo.shape[1],
+                    nnz=int(coo.rows.shape[0]),
+                    dtype=str(np.dtype(dtype)),
+                    default="coo",
+                )
+            )
+        if factor_format not in pkd.FACTOR_FORMATS:
+            raise ValueError(
+                f"unknown factor_format {factor_format!r}; choose from "
+                f"{pkd.FACTOR_FORMATS}"
+            )
+        self._factor_format = factor_format
         self._bind_factor(coo)
 
-    def _bind_factor(self, coo) -> None:
+    def _bind_factor(self, factor) -> None:
         """Bind a (new) half-chain factor: overflow-mode detection,
         tiling, cache reset. __init__ and the delta-update hook share
         this so a patched backend can never drift from a fresh build.
-        ``self.n`` is the LOGICAL source count — the factor's row axis
-        may be capacity-padded (data/delta.py headroom); padded rows
-        carry no entries and every sweep below is masked/trimmed to n.
+        ``factor`` is a COO (packed here when the ``factor_format``
+        knob says so) or an already-patched PackedFactor from the
+        delta path. ``self.n`` is the LOGICAL source count — the
+        factor's row axis may be capacity-padded (data/delta.py
+        headroom); padded rows carry no entries and every sweep below
+        is masked/trimmed to n.
         """
-        self._c = coo
+        from ..ops import packed as pkd
+
         self.n = self.hin.type_size(self.metapath.source_type)
+        tile_rows_eff = min(
+            self._tile_rows_req, max(int(factor.shape[0]), 8)
+        )
+        if pkd.is_packed(factor):
+            self._factor = factor
+            self._c = None
+        elif self._factor_format != "coo":
+            # chunk granularity = tile granularity, so every tile
+            # decode touches exactly its own chunks
+            self._factor = pkd.make_factor(
+                factor, self._factor_format, chunk_rows=tile_rows_eff
+            )
+            self._c = None
+        else:
+            self._factor = factor
+            self._c = factor
         dtype = self._dtype
         # Overflow detection (same cheap-bound → tight-per-row ladder
         # the TiledHalfChain guard uses, but the outcome is a MODE, not
@@ -134,25 +178,24 @@ class JaxSparseBackend(PathSimBackend):
             self.exact_counts
             and _chain.effective_device_dtype(dtype) == np.float32
         ):
-            s = self._c
-            colsum = np.zeros(s.shape[1], dtype=np.float64)
-            np.add.at(colsum, s.cols, s.weights)
+            colsum = np.asarray(
+                pkd.factor_colsum(self._factor), dtype=np.float64
+            )
             if float((colsum**2).sum()) >= _chain.F32_EXACT_INT_MAX:
-                rs = np.bincount(
-                    s.rows, weights=s.weights * colsum[s.cols],
-                    minlength=self.n,
-                )
+                rs = pkd.factor_rowsums_weighted(
+                    self._factor, colsum
+                )[: self.n]
                 if rs.max(initial=0.0) >= _chain.F32_EXACT_INT_MAX:
                     self._exact_rescore = True
                     self._host_rowsums = rs
         self.tiled = sp.TiledHalfChain(
-            self._c,
+            self._factor,
             # clamp to the factor's CAPACITY-padded row axis, not the
             # logical n: n grows on node appends, and a tile shape tied
             # to it would retrace every tile program per append —
             # exactly the recompile the capacity invariant exists to
-            # prevent. coo.shape[0] is delta-stable by construction.
-            tile_rows=min(self._tile_rows_req, max(coo.shape[0], 8)),
+            # prevent. factor.shape[0] is delta-stable by construction.
+            tile_rows=tile_rows_eff,
             nnz_bucket_floor=self._nnz_floor_req,
             dtype=dtype,
             # in rescore mode the f32 tiles are a prefilter by design;
@@ -165,6 +208,16 @@ class JaxSparseBackend(PathSimBackend):
         self._m: np.ndarray | None = None
         self._c_sum = None
         self._indptr = None
+        # memory-headroom visibility (the number this whole layout tier
+        # is about): resident factor bytes, labeled by format
+        from ..obs.metrics import get_registry
+
+        get_registry().gauge(
+            "dpathsim_factor_bytes",
+            "resident half-chain factor bytes by layout format",
+        ).labels(format=self._factor_format).set(
+            float(pkd.factor_bytes(self._factor))
+        )
 
     def _apply_delta_impl(self, plan) -> None:
         """Rebind to the plan's already-patched COO factor (ΔC came
@@ -175,7 +228,29 @@ class JaxSparseBackend(PathSimBackend):
         in power-of-two buckets), so steady-state updates compile
         nothing."""
         self.hin = plan.hin_new  # logical n may have grown (appends)
-        self._bind_factor(plan.half_new)
+        if self._c is None and plan.delta_c is not None:
+            # packed layouts: O(Δ) chunk-granular patch of the resident
+            # representation (ops/packed.patch_factor) — bit-identical
+            # in content to the plan's patched COO, but the 24-byte/nnz
+            # arrays are never materialized
+            from ..ops import packed as pkd
+
+            self._bind_factor(
+                pkd.patch_factor(self._factor, plan.delta_c)
+            )
+        else:
+            self._bind_factor(plan.half_new)
+
+    def factor_info(self) -> dict:
+        from ..ops import packed as pkd
+
+        nnz = pkd.factor_nnz(self._factor)
+        return {
+            "format": self._factor_format,
+            "bytes": pkd.factor_bytes(self._factor),
+            "nnz": nnz,
+            "coo_bytes": 24 * nnz,  # int64 rows + int64 cols + f64 w
+        }
 
     @property
     def _n_live_tiles(self) -> int:
@@ -283,17 +358,28 @@ class JaxSparseBackend(PathSimBackend):
         must fail, not resume."""
         import hashlib
 
+        from ..ops import packed as pkd
+
         c = self._c
-        h = hashlib.sha256()
-        h.update(np.ascontiguousarray(c.rows, dtype=np.int64).tobytes())
-        h.update(np.ascontiguousarray(c.cols, dtype=np.int64).tobytes())
-        h.update(np.ascontiguousarray(c.weights, dtype=np.float64).tobytes())
-        digest = h.hexdigest()[:16]
+        if c is not None:
+            # historical digest (raw arrays, pre-canonicalization
+            # order) so existing COO-mode checkpoint dirs stay
+            # resumable
+            h = hashlib.sha256()
+            h.update(np.ascontiguousarray(c.rows, dtype=np.int64).tobytes())
+            h.update(np.ascontiguousarray(c.cols, dtype=np.int64).tobytes())
+            h.update(
+                np.ascontiguousarray(c.weights, dtype=np.float64).tobytes()
+            )
+            digest = h.hexdigest()[:16]
+        else:
+            digest = pkd.content_digest(self._factor)
         scanned = self.tiled.dense_bytes() <= self._dense_c_budget
         return {
             "n": int(self.n),
-            "v": int(c.shape[1]),
-            "nnz": int(c.rows.shape[0]),
+            "v": int(self._factor.shape[1]),
+            "nnz": pkd.factor_nnz(self._factor),
+            "factor_format": self._factor_format,
             "digest": digest,
             "tile_rows": int(self.tiled.tile_rows),
             "k": int(k),
@@ -379,7 +465,10 @@ class JaxSparseBackend(PathSimBackend):
                 # old directory used cannot be known, so it must fail
                 # loudly rather than risk mixed numerics.)
                 config_defaults={"dtype": "float32", "exact_counts": True,
-                                 "variant": "rowsum"},
+                                 "variant": "rowsum",
+                                 # pre-compressed-layout directories
+                                 # were all COO by definition
+                                 "factor_format": "coo"},
             )
         if symmetric:
             return self._topk_scores_symmetric(k, ckpt, variant)
@@ -537,10 +626,15 @@ class JaxSparseBackend(PathSimBackend):
         C, no M). diag ≤ M's row sums elementwise, so the f32 guard on
         the row sums covers it."""
         if self._diag is None:
-            s = self._c.summed()
-            self._diag = np.bincount(
-                s.rows, weights=s.weights**2, minlength=self.n
-            ).astype(np.float64)
+            if self._c is None:
+                from ..ops import packed as pkd
+
+                self._diag = pkd.factor_diag(self._factor)[: self.n]
+            else:
+                s = self._c.summed()
+                self._diag = np.bincount(
+                    s.rows, weights=s.weights**2, minlength=self.n
+                ).astype(np.float64)
         return self._diag
 
     def _denoms_device_padded(self, variant: str = "rowsum"):
@@ -747,7 +841,16 @@ class JaxSparseBackend(PathSimBackend):
 
     def _densify_rows_f64(self, rows: np.ndarray) -> np.ndarray:
         """Dense f64 [len(rows), V] gather of arbitrary factor rows,
-        fully vectorized (the flat-expansion idiom from coo_matmul)."""
+        fully vectorized (the flat-expansion idiom from coo_matmul).
+        Packed layouts gather through the sanctioned accessor — same
+        exact integers, chunk-transient decode instead of a resident
+        CSR copy."""
+        if self._c is None:
+            from ..ops import packed as pkd
+
+            return pkd.gather_rows_dense(
+                self._factor, np.asarray(rows, dtype=np.int64)
+            )
         s, indptr = self._csr_factor()
         rows = np.asarray(rows, dtype=np.int64)
         starts = indptr[rows]

@@ -76,6 +76,15 @@ def build_parser() -> argparse.ArgumentParser:
         "the 1e-5 gate; only the guard is waived)",
     )
     p.add_argument(
+        "--factor-format",
+        default=None,
+        choices=("coo", "blocked", "bitpacked"),
+        help="jax-sparse: resident layout of the half-chain factor "
+        "(DESIGN.md §29) — compressed layouts hold it in 1/3-1/6 of "
+        "the COO bytes, bit-identically; default resolves through "
+        "the tuning registry ('coo' when untuned)",
+    )
+    p.add_argument(
         "--headroom",
         type=float,
         default=0.0,
@@ -423,6 +432,11 @@ def _run(args) -> int:
             "--tile-rows tunes the streaming tiled path and requires "
             "--backend jax-sparse"
         )
+    if args.factor_format is not None and args.backend != "jax-sparse":
+        raise ValueError(
+            "--factor-format selects the resident layout of the "
+            "sparse half-chain factor and requires --backend jax-sparse"
+        )
     if args.approx and args.backend not in ("jax", "jax-sparse"):
         raise ValueError(
             "--approx waives the f32 exact-count guard of the device "
@@ -444,6 +458,7 @@ def _run(args) -> int:
         loader=args.loader,
         tile_rows=args.tile_rows,
         approx=args.approx,
+        factor_format=args.factor_format,
         headroom=args.headroom,
         echo=not args.quiet,
         max_retries=args.max_retries,
@@ -577,6 +592,7 @@ def _run_multipath(args) -> int:
         "--checkpoint-dir": args.checkpoint_dir is not None,
         "--tile-rows": args.tile_rows is not None,
         "--approx": args.approx,
+        "--factor-format": args.factor_format is not None,
         "--headroom": args.headroom != 0.0,
         # the batched scorer has no tuned knobs — refuse rather than
         # silently ignore a table the user thinks is active

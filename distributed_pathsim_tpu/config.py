@@ -29,6 +29,12 @@ class RunConfig:
     loader: str = "auto"  # GEXF loader: auto | python | native
     tile_rows: int | None = None  # jax-sparse: rows per streaming tile
     approx: bool = False  # jax-sparse: waive the exact-count guard
+    # Resident sparse-factor layout (ops/packed.py, DESIGN.md §29):
+    # None resolves through the tuning registry (documented default:
+    # "coo", the uncompressed layout); "blocked"/"bitpacked" hold the
+    # half-chain factor compressed — bit-identical results, smaller
+    # resident graph, higher max-N at a fixed memory budget.
+    factor_format: str | None = None
     # Index-space capacity reserve (data/delta.py): 0.25 pads every type
     # by 25% so node appends up to the reserve never change array shapes
     # (the recompile-free delta-serving contract). 0 = no reserve.

@@ -199,6 +199,8 @@ def backend_options(config: RunConfig) -> dict:
         options["tile_rows"] = config.tile_rows
     if config.approx:
         options["exact_counts"] = False
+    if config.factor_format is not None:
+        options["factor_format"] = config.factor_format
     return options
 
 

@@ -488,36 +488,59 @@ def stream_row_tile_topk(c_all, d_all, i0, k: int, n_true: int,
 class TiledHalfChain:
     """Row-tiled dense view of a sparse half-chain factor C [N, V].
 
-    Host keeps C as CSR-sorted COO; tiles of ``tile_rows`` rows are
-    densified on device on demand. V (the contracted output width, e.g.
-    #venues) is assumed tileable as one dense axis — it is orders of
-    magnitude smaller than N in every target config.
+    Host keeps C as CSR-sorted COO — or, behind the ``factor_format``
+    knob, as a compressed :class:`~.packed.PackedFactor` whose chunks
+    align with the tile rows, in which case each tile's COO span is
+    decoded transiently through the sanctioned accessors and the full
+    24-byte/nnz arrays are never resident (the whole point of the
+    compressed formats, DESIGN.md §29). Tiles of ``tile_rows`` rows
+    are densified on device on demand either way; the device programs,
+    scatter-pad buckets, and numerics are identical by construction.
+    V (the contracted output width, e.g. #venues) is assumed tileable
+    as one dense axis — it is orders of magnitude smaller than N in
+    every target config.
     """
 
     def __init__(
         self,
-        c: COOMatrix,
+        c,
         tile_rows: int = 4096,
         dtype=jnp.float32,
         max_cached_tiles: int | None = None,
         exact_counts: bool = True,
         nnz_bucket_floor: int | None = None,
     ):
+        from . import packed as _packed
+
         self.n, self.v = c.shape
         self.tile_rows = int(tile_rows)
         self.dtype = dtype
-        order = np.argsort(c.rows, kind="stable")
-        self._rows = c.rows[order]
-        self._cols = c.cols[order]
-        self._weights = c.weights[order]
+        self._packed = c if _packed.is_packed(c) else None
         self.n_tiles = (self.n + self.tile_rows - 1) // self.tile_rows
-        # per-tile COO extents
-        bounds = np.arange(self.n_tiles + 1) * self.tile_rows
-        self._tile_start = np.searchsorted(self._rows, bounds[:-1], side="left")
-        self._tile_stop = np.searchsorted(self._rows, bounds[1:], side="left")
-        max_nnz = (
-            int((self._tile_stop - self._tile_start).max()) if self.n_tiles else 0
-        )
+        if self._packed is None:
+            order = np.argsort(c.rows, kind="stable")
+            self._rows = c.rows[order]
+            self._cols = c.cols[order]
+            self._weights = c.weights[order]
+            # per-tile COO extents
+            bounds = np.arange(self.n_tiles + 1) * self.tile_rows
+            self._tile_start = np.searchsorted(
+                self._rows, bounds[:-1], side="left"
+            )
+            self._tile_stop = np.searchsorted(
+                self._rows, bounds[1:], side="left"
+            )
+            tile_nnz = self._tile_stop - self._tile_start
+        else:
+            self._rows = self._cols = self._weights = None
+            self._tile_start = self._tile_stop = None
+            tile_nnz = np.asarray([
+                _packed.row_range_nnz(
+                    c, i * self.tile_rows, (i + 1) * self.tile_rows
+                )
+                for i in range(self.n_tiles)
+            ], dtype=np.int64)
+        max_nnz = int(tile_nnz.max()) if self.n_tiles else 0
         # Round the per-tile scatter pad up to a power of two: the
         # densify_tile program's traced shape is this pad, so a graph
         # delta that nudges the densest tile's nnz would otherwise
@@ -533,7 +556,7 @@ class TiledHalfChain:
             nnz_bucket_floor = int(
                 tuning.choose(
                     "sparse_nnz_floor", n=self.n, v=self.v,
-                    nnz=int(c.rows.shape[0]), default=1,
+                    nnz=_packed.factor_nnz(c), default=1,
                 )
             )
         self._nnz_bucket_floor = max(1, int(nnz_bucket_floor))
@@ -550,9 +573,16 @@ class TiledHalfChain:
         self._max_cached = int(max_cached_tiles)
         self._cache: dict[int, jax.Array] = {}  # insertion-ordered → LRU
         # Exact global column totals, accumulated in f64 on host: rowsums
-        # are C @ colsum_total and must stay integer-exact.
-        colsum = np.zeros(self.v, dtype=np.float64)
-        np.add.at(colsum, self._cols, self._weights)
+        # are C @ colsum_total and must stay integer-exact. The packed
+        # factor carries its exact colsum (kept patched by the delta
+        # path); the COO path accumulates it here — same numbers.
+        if self._packed is not None:
+            colsum = np.asarray(
+                _packed.factor_colsum(self._packed), dtype=np.float64
+            )
+        else:
+            colsum = np.zeros(self.v, dtype=np.float64)
+            np.add.at(colsum, self._cols, self._weights)
         self.colsum_total = colsum
         # f32 carries exact integers only to 2^24; a silently truncated
         # count would corrupt every downstream score, so refuse loudly.
@@ -576,26 +606,52 @@ class TiledHalfChain:
     def _check_exact_rowsums(self, dtype) -> None:
         """Tight per-row check, only run when the cheap bound trips."""
         from . import chain as _chain
+        from . import packed as _packed
 
-        rs = np.zeros(self.n, dtype=np.float64)
-        np.add.at(rs, self._rows, self._weights * self.colsum_total[self._cols])
+        if self._packed is not None:
+            rs = _packed.factor_rowsums_weighted(
+                self._packed, self.colsum_total
+            )
+        else:
+            rs = np.zeros(self.n, dtype=np.float64)
+            np.add.at(
+                rs, self._rows, self._weights * self.colsum_total[self._cols]
+            )
         _chain.check_exact_counts(rs.max(initial=0.0), dtype)
+
+    def _tile_span(self, i: int):
+        """Tile i's COO span as (local rows, cols, f64 weights) —
+        sliced views on the resident COO, or a transient decode of the
+        packed chunks the span touches."""
+        if self._packed is None:
+            s, e = int(self._tile_start[i]), int(self._tile_stop[i])
+            return (
+                self._rows[s:e] - i * self.tile_rows,
+                self._cols[s:e],
+                self._weights[s:e],
+            )
+        from . import packed as _packed
+
+        span = _packed.row_slice(
+            self._packed, i * self.tile_rows, (i + 1) * self.tile_rows
+        )
+        return span.rows - i * self.tile_rows, span.cols, span.weights
 
     def tile(self, i: int) -> jax.Array:
         """Dense [tile_rows, V] tile i of C (padded rows are zero)."""
         if i in self._cache:
             self._cache[i] = self._cache.pop(i)  # refresh LRU position
             return self._cache[i]
-        s, e = int(self._tile_start[i]), int(self._tile_stop[i])
-        nnz = e - s
+        t_rows, t_cols, t_w = self._tile_span(i)
+        nnz = t_rows.shape[0]
         # Pad every tile's COO slice to the same max nnz so one compiled
         # scatter program serves all tiles (static shapes for XLA).
         rows = np.zeros(self._max_nnz, dtype=np.int32)
         cols = np.zeros(self._max_nnz, dtype=np.int32)
         w = np.zeros(self._max_nnz, dtype=np.float64)
-        rows[:nnz] = self._rows[s:e] - i * self.tile_rows
-        cols[:nnz] = self._cols[s:e]
-        w[:nnz] = self._weights[s:e]
+        rows[:nnz] = t_rows
+        cols[:nnz] = t_cols
+        w[:nnz] = t_w
         t = densify_tile(
             jnp.asarray(rows),
             jnp.asarray(cols),
@@ -623,10 +679,19 @@ class TiledHalfChain:
         authors, V=64, f32) and holding it enables the scanned streaming
         pass (one dispatch per row tile instead of n_tiles²)."""
         if getattr(self, "_dense_c", None) is None:
+            if self._packed is None:
+                rows, cols, w = self._rows, self._cols, self._weights
+            else:
+                # one transient decode; the dense device factor it
+                # feeds is strictly larger than the decoded arrays
+                from . import packed as _packed
+
+                span = _packed.as_coo(self._packed)
+                rows, cols, w = span.rows, span.cols, span.weights
             self._dense_c = densify_tile(
-                jnp.asarray(self._rows, dtype=jnp.int32),
-                jnp.asarray(self._cols, dtype=jnp.int32),
-                jnp.asarray(self._weights, dtype=self.dtype),
+                jnp.asarray(rows, dtype=jnp.int32),
+                jnp.asarray(cols, dtype=jnp.int32),
+                jnp.asarray(w, dtype=self.dtype),
                 n_rows=self.n_tiles * self.tile_rows,
                 n_cols=self.v,
             )

@@ -128,13 +128,30 @@ def _build_partition_service(args):
         part_index=args.partition_index,
         n_parts=args.partitions,
         replication=args.partition_replication,
-        config=PartitionConfig(variant=args.variant, k_default=args.k),
+        config=PartitionConfig(
+            variant=args.variant, k_default=args.k,
+            factor_format=args.factor_format,
+        ),
     )
+
+
+def _check_factor_format(args) -> None:
+    """Same refusal the batch CLI makes: --factor-format selects the
+    jax-sparse resident layout; other backends would swallow it via
+    **options and serve uncompressed with no diagnostic."""
+    if args.factor_format is not None and args.backend != "jax-sparse":
+        raise ValueError(
+            "--factor-format selects the resident layout of the "
+            "sparse half-chain factor and requires --backend "
+            "jax-sparse (partition mode honors it regardless of "
+            "--backend: the slice layout is its own surface)"
+        )
 
 
 def _build_worker_service(args):
     """Serve-flag args → warm PathSimService (GEXF through the engine
     bootstrap; ``synthetic:`` specs built in-process)."""
+    _check_factor_format(args)
     from ..config import RunConfig
     from ..serving.service import ServeConfig, build_service
 
@@ -170,8 +187,12 @@ def _build_worker_service(args):
         # same base graph (the router's base_fp startup check)
         hin = _build_worker_hin(args)
         metapath = compile_metapath(args.metapath, hin.schema)
+        extra = (
+            {"factor_format": args.factor_format}
+            if args.factor_format else {}
+        )
         return PathSimService(
-            create_backend(args.backend, hin, metapath),
+            create_backend(args.backend, hin, metapath, **extra),
             variant=args.variant,
             config=serve_config,
         )
@@ -185,6 +206,7 @@ def _build_worker_service(args):
         n_devices=args.n_devices,
         tile_rows=args.tile_rows,
         approx=args.approx,
+        factor_format=args.factor_format,
         headroom=args.headroom,
         echo=False,
         tuning_table=args.tuning_table,
@@ -259,6 +281,7 @@ _FORWARD_VALUE = (
     "tuning_table", "topk_mode", "index", "ann_nprobe", "ann_cand_mult",
     "ann_centroids", "ann_cluster_cap", "ann_variant",
     "ann_shadow_every", "metrics_interval", "trace_sample",
+    "factor_format",
 )
 _FORWARD_TRUE = (
     "no_warm", "no_metrics", "no_tuning", "approx", "no_ann_refresh",

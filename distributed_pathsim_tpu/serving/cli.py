@@ -54,6 +54,14 @@ def build_serve_parser() -> argparse.ArgumentParser:
     p.add_argument("--n-devices", type=int, default=None)
     p.add_argument("--tile-rows", type=int, default=None)
     p.add_argument("--approx", action="store_true")
+    p.add_argument(
+        "--factor-format", default=None,
+        choices=("coo", "blocked", "bitpacked"),
+        help="resident sparse-factor layout (DESIGN.md §29): "
+        "compressed layouts hold the half-chain factor in 1/3-1/6 "
+        "of the COO bytes, bit-identically; default resolves "
+        "through the tuning registry ('coo' when untuned)",
+    )
     p.add_argument("--metrics", default=None, help="JSONL metrics/events file")
     p.add_argument("--k", type=int, default=10, help="default top-k")
     p.add_argument(
@@ -193,6 +201,13 @@ def serve_main(argv: list[str] | None = None) -> int:
             "serve runs one metapath per service; multi-metapath "
             "ensembles are not served yet"
         )
+    if args.factor_format is not None and args.backend != "jax-sparse":
+        # same refusal as the batch CLI: other backends would swallow
+        # the option via **options and serve uncompressed silently
+        raise ValueError(
+            "--factor-format selects the resident layout of the "
+            "sparse half-chain factor and requires --backend jax-sparse"
+        )
     from ..cli import _apply_platform, _require_tpu
 
     _apply_platform(args.platform)
@@ -210,6 +225,7 @@ def serve_main(argv: list[str] | None = None) -> int:
         n_devices=args.n_devices,
         tile_rows=args.tile_rows,
         approx=args.approx,
+        factor_format=args.factor_format,
         headroom=args.headroom,
         echo=False,
         tuning_table=args.tuning_table,

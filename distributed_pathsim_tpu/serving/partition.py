@@ -96,6 +96,10 @@ class PartitionConfig:
 
     variant: str = "rowsum"
     k_default: int = 10
+    # Resident layout of the held factor slice (the factor_format
+    # tuning knob, DESIGN.md §29): None resolves through the registry
+    # with the dense-slice "coo" behavior as the documented default.
+    factor_format: str | None = None
 
 
 class _BackendShim:
@@ -147,8 +151,17 @@ class PartitionService:
             [self.pmap.range_of(g) for g in self.held],
         )
         self.index = self.hin.indices[self.node_type]
+        fmt = self.config.factor_format
+        if fmt is None:
+            from .. import tuning
+
+            fmt = str(tuning.choose(
+                "factor_format", n=n, default="coo",
+            ))
+        self.factor_format = fmt
         self.fs: FactorSlice = build_factor_slice(
-            self.hin, metapath, self.pmap, self.held
+            self.hin, metapath, self.pmap, self.held,
+            factor_format=fmt,
         )
         self.n = self.pmap.n
         # fencing state: per-held-range row epochs + the global
@@ -181,6 +194,12 @@ class PartitionService:
         ).labels(
             ranges="+".join(str(g) for g in self.held)
         ).set(float(self.fs.n_held))
+        reg.gauge(
+            "dpathsim_factor_bytes",
+            "resident half-chain factor bytes by layout format",
+        ).labels(format=self.factor_format).set(
+            float(self.fs.factor_bytes())
+        )
         runtime_event(
             "partition_ready",
             part_index=self.part_index, partitions=self.pmap.p,
@@ -273,7 +292,8 @@ class PartitionService:
             "backend": self.backend.name,
             "fingerprint": self._fp,
             "partition": self.partition_state(),
-            "factor_bytes": int(self.fs.c_held.nbytes),
+            "factor_bytes": self.fs.factor_bytes(),
+            "factor_format": self.factor_format,
             "obs": {
                 "metrics": get_registry().enabled,
             },
@@ -316,7 +336,7 @@ class PartitionService:
             g = np.zeros(self.fs.v, dtype=np.float64)
             g[cols] = vals
             self._g = g
-            self._d_held = self.fs.c_held @ g
+            self._d_held = self.fs.matvec(g)
             runtime_event(
                 "partition_colsum_init", part_index=self.part_index,
                 nnz=int(cols.shape[0]), echo=False,
@@ -358,10 +378,10 @@ class PartitionService:
         dg[cols] = vals
         self._g = self._g + dg
         if cols.shape[0]:
-            self._d_held = self._d_held + self.fs.c_held @ dg
+            self._d_held = self._d_held + self.fs.matvec(dg)
         if changed.shape[0]:
             slots = self.fs.held_slot_of[changed]
-            self._d_held[slots] = self.fs.c_held[slots] @ self._g
+            self._d_held[slots] = self.fs.rows_matvec(slots, self._g)
             for g_idx in sorted({
                 self.pmap.owner_of(int(r)) for r in changed
             }):
@@ -370,6 +390,14 @@ class PartitionService:
         self._staged = None
         self.colsum_seq = seq
         self.update_seq = seq
+        # packed slices may re-bucket patched chunks — keep the
+        # memory-headroom gauge current
+        get_registry().gauge(
+            "dpathsim_factor_bytes",
+            "resident half-chain factor bytes by layout format",
+        ).labels(format=self.factor_format).set(
+            float(self.fs.factor_bytes())
+        )
         runtime_event(
             "partition_update_sealed", part_index=self.part_index,
             seq=seq, re_encoded=int(changed.shape[0]), echo=False,
@@ -398,7 +426,7 @@ class PartitionService:
             }
         self._require_ready()
         slot = int(self.fs.held_slot_of[row])
-        crow = self.fs.c_held[slot]
+        crow = self.fs.row_dense(slot)
         nz = np.flatnonzero(crow)
         return {
             "row": int(row),
@@ -447,7 +475,7 @@ class PartitionService:
         if hi_slot == lo_slot:
             return {"range": g, "cands": [], "seq": self.update_seq}
         c_s, d_source = self._source_tile(req)
-        c_win = self.fs.c_held[lo_slot:hi_slot]
+        c_win = self.fs.window_dense(lo_slot, hi_slot)
         d_win = self._d_held[lo_slot:hi_slot]
         m = c_win @ c_s  # exact: integer-valued f64 products
         scores = pathsim.score_candidates(
@@ -486,7 +514,7 @@ class PartitionService:
         g = int(req.get("range") or 0)
         lo_slot, hi_slot, glo, ghi = self._window(g)
         c_s, _ = self._source_tile(req)
-        m = self.fs.c_held[lo_slot:hi_slot] @ c_s
+        m = self.fs.window_dense(lo_slot, hi_slot) @ c_s
         d_win = self._d_held[lo_slot:hi_slot]
         self._m_partial.observe(
             time.perf_counter() - t0, op="partial_scores"

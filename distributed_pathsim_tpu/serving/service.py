@@ -189,7 +189,16 @@ class PathSimService:
             if self.config.memo_budget_mb is None
             else int(self.config.memo_budget_mb * (1 << 20))
         )
-        self.memo = planner.SubchainCache(budget) if budget > 0 else None
+        # Memo entries follow the backend's resident factor layout
+        # (the factor_format tuning knob, DESIGN.md §29): when the
+        # backend holds its factor packed, the shared sub-chain memo
+        # stores packed spans too — same byte budget, 3-6× more shared
+        # sub-chains resident.
+        memo_fmt = (backend.factor_info() or {}).get("format", "coo")
+        self.memo = (
+            planner.SubchainCache(budget, factor_format=memo_fmt)
+            if budget > 0 else None
+        )
         # _engines is read on coalescer threads mid-dispatch, where
         # taking _swap_lock would deadlock against update()'s
         # hold-and-drain — so the dict gets its own LEAF lock (never
@@ -1462,6 +1471,11 @@ class PathSimService:
                     self.memo.stats() if self.memo is not None else None
                 ),
             },
+            # Resident factor accounting (DESIGN.md §29): format,
+            # measured bytes, and the COO-equivalent bytes — the
+            # memory-headroom number the SLO/fleet-stats tier reads
+            # (None for backends with no resident sparse factor).
+            "factor": self.backend.factor_info(),
             "topk_mode": self.config.topk_mode,
             "ann": self._ann.snapshot() if self._ann is not None else None,
             "delta": {

@@ -369,6 +369,51 @@ def bench_planner(point: SweepPoint, reps: int) -> dict:
     return out
 
 
+def bench_factor_format(point: SweepPoint, reps: int, k: int = 10) -> dict:
+    """``factor_format`` — real arms: one jax-sparse backend per
+    resident layout over the same graph, raced on the batched serving
+    primitive (``topk_rows`` over a rotating row workload — the path
+    where a packed layout pays its decode cost). The knob's trade is
+    resident bytes vs decode time and fewer bytes is structurally
+    never faster, so racing on time alone could never pick a packed
+    layout: any arm within the measured noise of the fastest competes,
+    and among those the smallest resident factor wins (the
+    serve_buckets tie-break pattern). Measured bytes ride along per
+    arm so the entry stays auditable."""
+    from ..backends.base import create_backend
+    from ..data.synthetic import synthetic_hin
+    from ..ops.metapath import compile_metapath
+
+    n = point.n
+    hin = synthetic_hin(n, 2 * n, max(point.v // 4, 8), seed=0)
+    mp = compile_metapath("APVPA", hin.schema)
+    rng = np.random.default_rng(0)
+    rows = [rng.integers(0, n, size=16) for _ in range(6)]
+    backends = {}
+    bytes_by: dict[str, int] = {}
+    for fmt in KNOBS["factor_format"].candidates({"n": n}):
+        b = create_backend("jax-sparse", hin, mp, factor_format=fmt)
+        b.topk_rows(rows[0], k=k)  # compile outside the timed region
+        backends[fmt] = b
+        bytes_by[fmt] = int(b.factor_info()["bytes"])
+
+    def arm(fmt: str):
+        b = backends[fmt]
+        return _cycled(lambda r: b.topk_rows(r, k=k), rows)
+
+    res = br.time_interleaved({f: arm(f) for f in backends}, reps)
+    for fmt in backends:
+        res[fmt]["factor_bytes"] = bytes_by[fmt]
+    noise = br.noise_bound(res)
+    floor_ms = res[br.best_arm(res)]["median_of_best_ms"] * (1.0 + noise)
+    winner = min(
+        (f for f in backends
+         if res[f]["median_of_best_ms"] <= floor_ms),
+        key=lambda f: (bytes_by[f], f),
+    )
+    return {"factor_format": (winner, res)}
+
+
 def bench_ring(point: SweepPoint, reps: int, k: int = 10) -> dict:
     """Ring-step fold choice on a 1-device mesh: the same compiled
     shard_map program a real slice runs per step, minus the ICI hop —
@@ -733,6 +778,10 @@ def tune(
                     # ann knobs gate on measured recall before racing
                     # on time — persist it per arm for the same reason
                     arms_out[f"{name}_recall"] = a["recall"]
+                if "factor_bytes" in a:
+                    # factor_format picks within the noise band by
+                    # resident bytes — persist the deciding number
+                    arms_out[f"{name}_bytes"] = float(a["factor_bytes"])
             table.put(
                 key, choice,
                 metric_ms=min(
@@ -759,6 +808,8 @@ def tune(
                 record(point, bench_ann(point, reps))
             if want & {"plan_density_cutover", "plan_memo_budget_mb"}:
                 record(point, bench_planner(point, reps))
+            if "factor_format" in want:
+                record(point, bench_factor_format(point, reps))
         else:
             if "sparse_tile_rows" in want:
                 record(point, bench_sparse_tiles(point, reps),

@@ -185,6 +185,21 @@ KNOBS: dict[str, Knob] = {
             candidates=lambda ctx: [16.0, 64.0, 256.0],
         ),
         Knob(
+            name="factor_format",
+            doc="resident layout of the sparse half-chain factor "
+            "(ops/packed.py, DESIGN.md §29): 'coo' (24 B/nnz, zero "
+            "transform cost), 'blocked' (chunked CSR, hub-first "
+            "permuted narrow-dtype columns + narrow integer counts, "
+            "~3-6 B/nnz), 'bitpacked' (blocked plus per-block "
+            "fixed-width bit-packing of delta-encoded column ids, "
+            "~1.5-3 B/nnz). Trades decode time per tile/patch for "
+            "resident bytes — i.e. for max-N at a fixed memory "
+            "budget, single-chip and per-partition. Bit-invisible: "
+            "every accessor returns original ids and exact f64 "
+            "integers (pack/unpack round trip property-tested).",
+            candidates=lambda ctx: ["coo", "blocked", "bitpacked"],
+        ),
+        Knob(
             name="serve_buckets",
             doc="serving bucket-ladder geometry pre-compiled at "
             "warmup: 'pow2' (1,2,4,…; <2x pad waste, log2(B)+1 "
@@ -249,6 +264,18 @@ SANCTIONED_CONSTANTS: dict[str, frozenset[str]] = {
         # FactorStats, not a measured performance choice; the planner's
         # real knobs (plan_density_cutover, plan_dp_max_len,
         # plan_memo_budget_mb) are registry knobs above
+    }),
+    "ops/packed.py": frozenset({
+        "_PACK_CHUNK_ROWS",    # delta re-encode / tile-alignment chunk
+        # granularity — consumers pass their own tile width; this is
+        # the standalone default, a layout invariant
+        "_BLOCK_NNZ",          # bit-packing width-adaptation block size
+        # (each block stores its own bit width) — layout invariant of
+        # the bitpacked stream, not a measured perf choice
+        "_PACK_BUCKET_FLOOR",  # pow-2 chunk-buffer capacity floor: the
+        # realloc-stability contract (delta-drifted nnz stays inside
+        # one bucket), analogous to sparse_nnz_floor's role but for
+        # host buffers; the measured knob is factor_format above
     }),
     "obs/metrics.py": frozenset({
         "DEFAULT_BUCKETS_PER_DECADE",  # histogram resolution (quantile

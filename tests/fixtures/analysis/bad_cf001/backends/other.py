@@ -1,0 +1,7 @@
+"""BAD: calls a packed constructor around the factory (CF001)."""
+
+from ..ops import packed
+
+
+def sneaky_pack(c):
+    return packed._pack_chunk(c.rows, c.cols, c.weights)

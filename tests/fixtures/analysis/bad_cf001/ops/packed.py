@@ -1,0 +1,19 @@
+"""Fixture mirror of ops/packed.py: surface + factory registries."""
+PACKED_SURFACE = frozenset({"chunks", "row_counts", "block_bits", "col_perm"})
+SANCTIONED_FACTORY = frozenset({"make_factor", "as_coo", "factor_bytes"})
+
+
+def _pack_chunk(rows, cols, weights):
+    return (rows, cols, weights)
+
+
+def make_factor(c, fmt):
+    return _pack_chunk(c.rows, c.cols, c.weights)
+
+
+def as_coo(f):
+    return f
+
+
+def factor_bytes(f):
+    return 0

@@ -1,0 +1,7 @@
+"""GOOD: packed factors built and read through the factory only."""
+
+from ..ops import packed
+
+
+def resident_bytes(c, fmt):
+    return packed.factor_bytes(packed.make_factor(c, fmt))

@@ -1,0 +1,524 @@
+"""Compressed sparse factor formats (ISSUE 14 / DESIGN.md §29).
+
+The load-bearing guarantees:
+
+- pack → unpack is the identity onto canonical COO — entry-for-entry,
+  ORIGINAL ids, exact f64 integer weights — for both packed layouts,
+  any chunk geometry, random inputs (so every downstream consumer is
+  bit-identical by construction);
+- the hub-first permutations (data/compress.py) invert exactly at
+  every host boundary, and identity-extend under append growth;
+- the jax-sparse packed arms, the packed sub-chain memo (exercised
+  through all four backends), and the packed partition slice are all
+  bit-identical to their COO twins — counts, f64 scores, top-k tie
+  order — through random delta sequences including headroom-padded
+  node appends;
+- narrow-dtype overflow PROMOTES (wider dtype, counted, exact) —
+  a silent wrap is impossible because dtypes are re-chosen from
+  actual values at every (re-)encode;
+- the measured smoke: ≥1.5× resident reduction, higher max-N at
+  budget (single-chip and per-partition), zero steady-state
+  recompiles through a delta-interleaved run.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from distributed_pathsim_tpu.backends.base import create_backend
+from distributed_pathsim_tpu.backends.partition_factors import (
+    build_factor_slice,
+    patch_factor_slice,
+    range_colsums,
+)
+from distributed_pathsim_tpu.data import delta as dl
+from distributed_pathsim_tpu.data.compress import (
+    PermutationPair,
+    degree_order,
+    factor_permutations,
+    hin_degree_permutations,
+)
+from distributed_pathsim_tpu.data.partition import PartitionMap
+from distributed_pathsim_tpu.data.synthetic import synthetic_hin
+from distributed_pathsim_tpu.ops import packed as pk
+from distributed_pathsim_tpu.ops import planner
+from distributed_pathsim_tpu.ops import sparse as sp
+from distributed_pathsim_tpu.ops.metapath import compile_metapath
+
+BACKENDS = ["numpy", "jax", "jax-sparse", "jax-sharded"]
+PACKED_FORMATS = ["blocked", "bitpacked"]
+
+
+def _random_coo(rng, n=None, v=None, nnz=None, wmax=300) -> sp.COOMatrix:
+    n = n or int(rng.integers(1, 700))
+    v = v or int(rng.integers(1, 250))
+    nnz = int(rng.integers(0, 3000)) if nnz is None else nnz
+    return sp.COOMatrix(
+        rows=rng.integers(0, n, nnz).astype(np.int64),
+        cols=rng.integers(0, v, nnz).astype(np.int64),
+        weights=rng.integers(1, wmax, nnz).astype(np.float64),
+        shape=(n, v),
+    )
+
+
+def _canon(c: sp.COOMatrix) -> sp.COOMatrix:
+    return sp.coo_nonzero(c.summed())
+
+
+def _coo_equal(a: sp.COOMatrix, b: sp.COOMatrix) -> bool:
+    return (
+        a.shape == b.shape
+        and np.array_equal(a.rows, b.rows)
+        and np.array_equal(a.cols, b.cols)
+        and np.array_equal(a.weights, b.weights)
+    )
+
+
+# -- pack/unpack round trip: the identity onto canonical COO --------------
+
+
+@pytest.mark.parametrize("fmt", PACKED_FORMATS)
+def test_pack_unpack_roundtrip_property(fmt):
+    rng = np.random.default_rng(5)
+    for trial in range(6):
+        c = _random_coo(rng)
+        cc = _canon(c)
+        for chunk_rows in (1, 64, 4096):
+            f = pk.make_factor(c, fmt, chunk_rows=chunk_rows)
+            assert _coo_equal(pk.as_coo(f), cc), (trial, chunk_rows)
+            # digest is format-independent (checkpoint/cache identity
+            # survives a layout flip)
+            assert pk.content_digest(f) == pk.content_digest(cc)
+            assert pk.factor_nnz(f) == cc.rows.shape[0]
+            assert pk.factor_bytes(f) > 0
+            assert np.array_equal(
+                pk.factor_colsum(f), pk.factor_colsum(cc)
+            )
+
+
+@pytest.mark.parametrize("fmt", PACKED_FORMATS)
+def test_row_slice_gather_and_marginals_match_reference(fmt):
+    rng = np.random.default_rng(9)
+    for _ in range(4):
+        c = _random_coo(rng)
+        cc = _canon(c)
+        n, v = cc.shape
+        f = pk.make_factor(c, fmt, chunk_rows=int(rng.integers(1, 300)))
+        r0, r1 = sorted(rng.integers(0, n + 1, 2).tolist())
+        m = (cc.rows >= r0) & (cc.rows < r1)
+        sl = pk.row_slice(f, r0, r1)
+        assert np.array_equal(sl.rows, cc.rows[m])
+        assert np.array_equal(sl.cols, cc.cols[m])
+        assert np.array_equal(sl.weights, cc.weights[m])
+        assert pk.row_range_nnz(f, r0, r1) == int(m.sum())
+        dense = np.zeros((n, v))
+        dense[cc.rows, cc.cols] = cc.weights
+        sel = rng.integers(0, n, 9)
+        assert np.array_equal(pk.gather_rows_dense(f, sel), dense[sel])
+        colvec = rng.integers(0, 7, v).astype(np.float64)
+        assert np.array_equal(
+            pk.factor_rowsums_weighted(f, colvec), dense @ colvec
+        )
+        assert np.array_equal(pk.factor_diag(f), (dense**2).sum(axis=1))
+
+
+def test_coo_format_is_passthrough():
+    rng = np.random.default_rng(1)
+    c = _random_coo(rng)
+    assert pk.make_factor(c, "coo") is c
+    assert pk.as_coo(c) is c
+    with pytest.raises(ValueError, match="unknown factor format"):
+        pk.make_factor(c, "zstd")
+
+
+# -- permutations: hub-first order, exact inversion, append extension -----
+
+
+def test_degree_order_is_hub_first_and_deterministic():
+    deg = np.array([3, 9, 9, 0, 5])
+    perm = degree_order(deg)
+    # descending degree, ascending index on ties
+    assert perm.tolist() == [1, 2, 4, 0, 3]
+    assert np.array_equal(perm, degree_order(deg))
+
+
+def test_permutation_pair_inverts_exactly_and_extends_identity():
+    rng = np.random.default_rng(3)
+    pair = PermutationPair.from_perm(rng.permutation(64))
+    idx = rng.integers(0, 64, size=200)
+    assert np.array_equal(pair.invert(pair.apply(idx)), idx)
+    assert np.array_equal(pair.apply(pair.invert(idx)), idx)
+    grown = pair.extend(80)
+    # old slots keep their mapping; appended slots map to themselves —
+    # the contract that makes node appends O(Δ) for packed layouts
+    assert np.array_equal(grown.apply(idx), pair.apply(idx))
+    tail = np.arange(64, 80)
+    assert np.array_equal(grown.apply(tail), tail)
+    assert np.array_equal(grown.invert(tail), tail)
+    with pytest.raises(ValueError, match="cannot shrink"):
+        pair.extend(10)
+
+
+def test_hin_degree_permutations_cover_every_boundary():
+    hin = synthetic_hin(120, 200, 9, seed=2)
+    pairs = hin_degree_permutations(hin)
+    for node_type, idx in hin.indices.items():
+        pair = pairs[node_type]
+        assert pair.n == idx.padded_size
+        ids = np.arange(pair.n)
+        assert np.array_equal(pair.invert(pair.apply(ids)), ids)
+    # hub-first: block degrees are non-increasing along the permutation
+    b = hin.blocks["author_of"]
+    deg = np.bincount(b.rows, minlength=hin.indices["author"].padded_size)
+    ordered = deg[pairs["author"].perm]
+    assert (np.diff(ordered) <= 0).all()
+
+
+def test_factor_permutations_shrink_used_column_range():
+    rng = np.random.default_rng(8)
+    c = _random_coo(rng, n=200, v=500, nnz=400)
+    cc = _canon(c)
+    _, col_pair = factor_permutations(cc.rows, cc.cols, cc.shape)
+    pcols = col_pair.apply(cc.cols)
+    used = np.unique(cc.cols).shape[0]
+    # hub-first packs every used column below the used-count watermark
+    assert int(pcols.max()) == used - 1
+
+
+# -- jax-sparse packed arms: bit parity on every primitive ----------------
+
+
+@pytest.mark.parametrize("fmt", PACKED_FORMATS)
+def test_jax_sparse_packed_arm_bit_parity(fmt):
+    hin = synthetic_hin(260, 520, 12, seed=4)
+    mp = compile_metapath("APVPA", hin.schema)
+    ref = create_backend("jax-sparse", hin, mp)
+    b = create_backend("jax-sparse", hin, mp, factor_format=fmt)
+    rows = np.array([0, 3, 131, 259])
+    assert np.array_equal(b.global_walks(), ref.global_walks())
+    assert np.array_equal(b.diag_walks(), ref.diag_walks())
+    assert np.array_equal(b.scores_rows(rows), ref.scores_rows(rows))
+    bv, bi = b.topk_rows(rows, k=7)
+    rv, ri = ref.topk_rows(rows, k=7)
+    assert np.array_equal(bv, rv) and np.array_equal(bi, ri)
+    sv, si = b.topk_scores(k=5)
+    ov, oi = ref.topk_scores(k=5)
+    assert np.array_equal(sv, ov) and np.array_equal(si, oi)
+    info = b.factor_info()
+    assert info["format"] == fmt
+    assert 0 < info["bytes"] < info["coo_bytes"]
+    assert ref.factor_info()["format"] == "coo"
+
+
+def test_factor_format_rejects_unknown():
+    hin = synthetic_hin(40, 80, 4, seed=0)
+    mp = compile_metapath("APVPA", hin.schema)
+    with pytest.raises(ValueError, match="unknown factor_format"):
+        create_backend("jax-sparse", hin, mp, factor_format="gzip")
+
+
+# -- packed sub-chain memo: all four backends, warm == cold ---------------
+
+
+@pytest.mark.parametrize("backend_name", BACKENDS)
+def test_all_backends_bit_identical_with_packed_memo(backend_name):
+    """Every backend folds its chain through the planner; a packed
+    memo sits on that path for all of them. Cold (miss → pack) and
+    warm (hit → unpack) builds must both equal the memo-less oracle —
+    counts, scores, tie order."""
+    hin = synthetic_hin(150, 300, 8, seed=6)
+    mp = compile_metapath("APVPA", hin.schema)
+    oracle = create_backend(backend_name, hin, mp)
+    rows = np.array([0, 17, 149])
+    ov, oi = oracle.topk_rows(rows, k=6)
+    memo = planner.SubchainCache(32 << 20, factor_format="bitpacked")
+    for round_name in ("cold", "warm"):
+        b = create_backend(backend_name, hin, mp, subchain_memo=memo)
+        assert np.array_equal(
+            b.scores_rows(rows), oracle.scores_rows(rows)
+        ), round_name
+        bv, bi = b.topk_rows(rows, k=6)
+        assert np.array_equal(bv, ov) and np.array_equal(bi, oi), (
+            backend_name, round_name,
+        )
+    assert memo.hits > 0  # the warm build actually used packed entries
+
+
+def test_packed_memo_charges_packed_bytes_and_hits_exactly():
+    hin = synthetic_hin(180, 360, 10, seed=12)
+    mp = compile_metapath("APVPA", hin.schema)
+    coo_memo = planner.SubchainCache(32 << 20)
+    pkd_memo = planner.SubchainCache(32 << 20, factor_format="bitpacked")
+    a = planner.fold_half(hin, mp, memo=coo_memo)
+    b = planner.fold_half(hin, mp, memo=pkd_memo)
+    assert _coo_equal(_canon(a), _canon(b))
+    assert 0 < pkd_memo.stats()["bytes"] < coo_memo.stats()["bytes"]
+    # a warm hit on a canonical interior fold is BYTE-identical
+    warm = planner.fold_half(hin, mp, memo=pkd_memo)
+    assert pkd_memo.hits > 0
+    assert _coo_equal(_canon(warm), _canon(a))
+
+
+# -- delta sequences: packed arms stay exact through appends --------------
+
+
+def _random_delta(hin, rng, n_changes=12, append=False):
+    """Random adds/removes over both half-chain blocks, optionally
+    appending one author wired in by an added edge (the test_delta
+    shape, replayed against the packed arms)."""
+    edges = []
+    per_rel = max(n_changes // 2, 2)
+    for rel in ("author_of", "submit_at"):
+        b = hin.blocks[rel]
+        n_src = hin.type_size(b.src_type)
+        n_dst = hin.type_size(b.dst_type)
+        n_rem = per_rel // 2
+        rem_i = rng.choice(b.nnz, size=n_rem, replace=False)
+        removes = np.stack([b.rows[rem_i], b.cols[rem_i]], axis=1)
+        existing = set(zip(b.rows.tolist(), b.cols.tolist()))
+        adds = []
+        while len(adds) < per_rel - n_rem:
+            e = (int(rng.integers(0, n_src)), int(rng.integers(0, n_dst)))
+            if e not in existing:
+                existing.add(e)
+                adds.append(e)
+        edges.append(dl.edge_delta(rel, add=adds, remove=removes))
+    nodes = ()
+    if append:
+        n_auth = hin.type_size("author")
+        nodes = (
+            dl.NodeAppend(node_type="author", ids=(f"author_{n_auth}",)),
+        )
+        edges[0] = dl.edge_delta(
+            "author_of",
+            add=np.concatenate([
+                edges[0].add,
+                [[n_auth, int(rng.integers(0, hin.type_size("paper")))]],
+            ]),
+            remove=edges[0].remove,
+        )
+    return dl.DeltaBatch(edges=tuple(edges), nodes=nodes)
+
+
+@pytest.mark.parametrize("fmt", PACKED_FORMATS)
+def test_packed_delta_sequence_parity_with_appends(fmt):
+    rng = np.random.default_rng(11)
+    hin = dl.with_headroom(
+        synthetic_hin(96, 150, 7, seed=3, materialize_ids=True), 0.3
+    )
+    mp = compile_metapath("APVPA", hin.schema)
+    b = create_backend("jax-sparse", hin, mp, factor_format=fmt)
+    shape0 = (b.tiled.tile_rows, b.tiled.n_tiles, b.tiled._max_nnz)
+    for step in range(4):
+        delta = _random_delta(hin, rng, n_changes=12, append=step % 2 == 0)
+        plan = dl.plan_delta(hin, delta, mp, max_delta_fraction=0.5)
+        assert not plan.fallback, plan.reason
+        b.apply_delta(plan)
+        hin = plan.hin_new
+        fresh = create_backend("jax-sparse", dl.strip_headroom(hin), mp)
+        rows = np.arange(hin.type_size("author"))
+        assert np.array_equal(
+            b.scores_rows(rows), fresh.scores_rows(rows)
+        ), (fmt, step)
+        assert np.array_equal(b.global_walks(), fresh.global_walks())
+        bv, bi = b.topk_rows(rows, k=5)
+        fv, fi = fresh.topk_rows(rows, k=5)
+        assert np.array_equal(bv, fv) and np.array_equal(bi, fi)
+    # the recompile-free contract's shape half: appends never move the
+    # tile geometry of a packed bind either
+    assert (b.tiled.tile_rows, b.tiled.n_tiles, b.tiled._max_nnz) == shape0
+
+
+def test_patch_factor_matches_row_granular_coo_patch():
+    rng = np.random.default_rng(21)
+    for fmt in PACKED_FORMATS:
+        c = _random_coo(rng, n=400, v=60, nnz=1500)
+        cc = _canon(c)
+        f = pk.make_factor(c, fmt, chunk_rows=64)
+        dn = 40
+        dc = _canon(sp.COOMatrix(
+            rows=rng.integers(0, 400, dn).astype(np.int64),
+            cols=rng.integers(0, 60, dn).astype(np.int64),
+            weights=rng.choice([-1.0, 1.0, 2.0], dn),
+            shape=(400, 60),
+        ))
+        ref = _canon(sp.coo_apply_delta(cc, dc))
+        patched = pk.patch_factor(f, dc)
+        assert _coo_equal(pk.as_coo(patched), _canon(ref))
+        assert np.array_equal(
+            pk.factor_colsum(patched), pk.factor_colsum(ref)
+        )
+
+
+# -- narrow dtypes: overflow promotes loudly, never wraps -----------------
+
+
+def test_pack_chooses_dtype_from_actual_range():
+    rows = np.zeros(2, dtype=np.int64)
+    cols = np.arange(2, dtype=np.int64)
+    small = sp.COOMatrix(rows=rows, cols=cols,
+                         weights=np.array([3.0, 200.0]), shape=(2, 4))
+    big = sp.COOMatrix(rows=rows, cols=cols,
+                       weights=np.array([3.0, 70000.0]), shape=(2, 4))
+    f_small = pk.make_factor(small, "blocked")
+    f_big = pk.make_factor(big, "blocked")
+    assert pk.as_coo(f_small).weights.tolist() == [3.0, 200.0]
+    assert pk.as_coo(f_big).weights.tolist() == [3.0, 70000.0]
+    assert pk.factor_bytes(f_big) >= pk.factor_bytes(f_small)
+
+
+def test_non_integer_weights_fall_back_to_f64_lossless():
+    rows = np.zeros(2, dtype=np.int64)
+    cols = np.arange(2, dtype=np.int64)
+    c = sp.COOMatrix(rows=rows, cols=cols,
+                     weights=np.array([0.5, -2.25]), shape=(2, 4))
+    for fmt in PACKED_FORMATS:
+        out = pk.as_coo(pk.make_factor(c, fmt))
+        assert out.weights.tolist() == [-2.25, 0.5] or (
+            out.weights.tolist() == [0.5, -2.25]
+        )
+        assert np.array_equal(
+            sorted(out.weights.tolist()), sorted(c.weights.tolist())
+        )
+
+
+@pytest.mark.parametrize("fmt", PACKED_FORMATS)
+def test_overflow_promotes_loudly_never_wraps(fmt):
+    from distributed_pathsim_tpu.obs.metrics import get_registry
+
+    rows = np.zeros(3, dtype=np.int64)
+    cols = np.arange(3, dtype=np.int64)
+    c = sp.COOMatrix(rows=rows, cols=cols, weights=np.ones(3),
+                     shape=(4, 4))
+    f = pk.make_factor(c, fmt, chunk_rows=4)
+    counter = get_registry().counter(
+        "dpathsim_packed_promotions_total",
+        "packed-chunk weight dtype widenings (loud, never a wrap)",
+    ).labels(format=fmt)
+    before = counter.value
+    dc = sp.COOMatrix(
+        rows=np.zeros(1, dtype=np.int64),
+        cols=np.zeros(1, dtype=np.int64),
+        weights=np.array([300.0]), shape=(4, 4),
+    )
+    f2 = pk.patch_factor(f, dc)
+    assert pk.as_coo(f2).weights[0] == 301.0  # exact — 301, not 45
+    assert f2.promotions == f.promotions + 1
+    assert counter.value == before + 1
+
+
+# -- partition slice: packed windows equal the dense slice ----------------
+
+
+@pytest.mark.parametrize("fmt", PACKED_FORMATS)
+def test_partition_factor_slice_packed_matches_dense(fmt):
+    from distributed_pathsim_tpu.data.partition import slice_hin
+
+    hin = synthetic_hin(140, 230, 8, seed=11)
+    mp = compile_metapath("APVPA", hin.schema)
+    pmap = PartitionMap(n=hin.type_size("author"), p=3)
+    held = pmap.held_by(0, 2)
+    hs = slice_hin(hin, "author", [pmap.range_of(g) for g in held])
+    dense = build_factor_slice(hs, mp, pmap, held)
+    packed = build_factor_slice(hs, mp, pmap, held, factor_format=fmt)
+    assert packed.c_held is None and packed.factor_bytes() > 0
+    assert packed.factor_bytes() < dense.factor_bytes()
+    assert packed.n_held == dense.n_held and packed.v == dense.v
+    g = np.arange(dense.v, dtype=np.float64) + 1.0
+    assert np.array_equal(packed.matvec(g), dense.c_held @ g)
+    for gr in held:
+        lo, hi = dense.range_slots[gr]
+        assert np.array_equal(
+            packed.window_dense(lo, hi), dense.c_held[lo:hi]
+        )
+        assert np.array_equal(
+            packed.window_colsum(lo, hi),
+            dense.c_held[lo:hi].sum(axis=0),
+        )
+    assert range_colsums(packed, held) == range_colsums(dense, held)
+    # a row-granular patch stays equivalent in both layouts
+    rng = np.random.default_rng(0)
+    dn = 12
+    lo0, hi0 = pmap.range_of(held[0])
+    dc = _canon(sp.COOMatrix(
+        rows=rng.integers(lo0, hi0, dn).astype(np.int64),
+        cols=rng.integers(0, dense.v, dn).astype(np.int64),
+        weights=rng.choice([-1.0, 1.0], dn),
+        shape=(pmap.n, dense.v),
+    ))
+    ch_d = patch_factor_slice(dense, dc, pmap.n)
+    ch_p = patch_factor_slice(packed, dc, pmap.n)
+    assert np.array_equal(ch_d, ch_p)
+    assert np.array_equal(packed.matvec(g), dense.c_held @ g)
+    slots = dense.held_slot_of[ch_d]
+    assert np.array_equal(
+        packed.rows_matvec(slots, g), dense.c_held[slots] @ g
+    )
+
+
+# -- observability: stats + gauge export the number this is all about -----
+
+
+def test_service_stats_and_gauge_report_factor_bytes():
+    from distributed_pathsim_tpu.obs.metrics import get_registry
+    from distributed_pathsim_tpu.serving import PathSimService, ServeConfig
+
+    hin = synthetic_hin(96, 180, 8, seed=1)
+    mp = compile_metapath("APVPA", hin.schema)
+    svc = PathSimService(
+        create_backend("jax-sparse", hin, mp, factor_format="blocked"),
+        config=ServeConfig(warm=False),
+    )
+    try:
+        factor = svc.stats()["factor"]
+        assert factor["format"] == "blocked"
+        assert 0 < factor["bytes"] < factor["coo_bytes"]
+        cell = get_registry().gauge(
+            "dpathsim_factor_bytes",
+            "resident half-chain factor bytes by layout format",
+        ).labels(format="blocked")
+        assert cell.value == float(factor["bytes"])
+    finally:
+        svc.close()
+    # backends with no resident sparse factor report None, not garbage
+    svc2 = PathSimService(
+        create_backend("numpy", hin, mp), config=ServeConfig(warm=False)
+    )
+    try:
+        assert svc2.stats()["factor"] is None
+    finally:
+        svc2.close()
+
+
+def test_factor_format_knob_and_constants_registered():
+    from distributed_pathsim_tpu.tuning.registry import (
+        KNOBS,
+        SANCTIONED_CONSTANTS,
+    )
+
+    assert set(KNOBS["factor_format"].candidates({})) == {
+        "coo", "blocked", "bitpacked",
+    }
+    assert "_PACK_BUCKET_FLOOR" in SANCTIONED_CONSTANTS["ops/packed.py"]
+
+
+# -- the measured gate (make compress-smoke, tier-1) ----------------------
+
+
+def test_bench_compress_smoke(tmp_path):
+    import os
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, repo)
+    try:
+        import bench_serving
+
+        result = bench_serving.run_compress_smoke(
+            str(tmp_path / "compress.json")
+        )
+    finally:
+        sys.path.remove(repo)
+    assert all(result["smoke_checks"].values()), result["smoke_checks"]
+    assert result["summary"]["best_factor_reduction"] >= 1.5

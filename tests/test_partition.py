@@ -164,12 +164,21 @@ class _PartFleet:
     """P inproc partition workers + a PartitionRouter, one unit."""
 
     def __init__(self, hin, metapath, partitions: int,
-                 replication: int = 2, **router_cfg):
+                 replication: int = 2, factor_format: str | None = None,
+                 **router_cfg):
+        from distributed_pathsim_tpu.serving.partition import (
+            PartitionConfig,
+        )
+
         self.transports = {}
         self.services = []
         for i in range(partitions):
             svc = PartitionService(
-                hin, metapath, i, partitions, replication
+                hin, metapath, i, partitions, replication,
+                config=(
+                    PartitionConfig(factor_format=factor_format)
+                    if factor_format else None
+                ),
             )
             self.services.append(svc)
             self.transports[f"w{i}"] = InprocTransport(
@@ -228,7 +237,12 @@ def test_partition_oracle_parity_property():
     (tiny venue count ⇒ massive score-tie plateaus, so the
     (−score, ascending col) order is genuinely exercised)."""
     rng = np.random.default_rng(29)
-    for p_count in (2, 4, 5):
+    # the last arm holds its slices PACKED (the factor_format knob,
+    # DESIGN.md §29): same wire, same oracle, same bit-exact gate —
+    # compression must be invisible to everything downstream
+    for p_count, factor_format in (
+        (2, None), (4, None), (5, None), (3, "bitpacked"),
+    ):
         # few venues → many identical score values → tie-order stress
         hin = synthetic_hin(
             50 + int(rng.integers(0, 40)), 90, 3,
@@ -236,7 +250,10 @@ def test_partition_oracle_parity_property():
         )
         mp = compile_metapath("APVPA", hin.schema)
         oracle = _oracle(hin, mp)
-        fleet = _PartFleet(hin, mp, p_count, replication=2)
+        fleet = _PartFleet(
+            hin, mp, p_count, replication=2,
+            factor_format=factor_format,
+        )
         try:
             for _delta_round in range(3):
                 for row in rng.integers(0, oracle.n, size=6):

@@ -252,6 +252,7 @@ def _forced_table():
     t.put(make_key("sparse_nnz_floor", dev), 256)
     t.put(make_key("ring_kernel", dev), "jnp-fold")
     t.put(make_key("serve_buckets", dev), "coarse")
+    t.put(make_key("factor_format", dev), "bitpacked")
     return t
 
 
