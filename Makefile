@@ -5,8 +5,8 @@ PYTHON ?= python
 
 .PHONY: test chaos chaos-router serve-smoke update-smoke obs-smoke \
 	router-smoke partition-smoke ann-smoke fleet-obs-smoke \
-	metapath-smoke compress-smoke lint lint-schema lint-telemetry \
-	tune-smoke lint-tuning tune
+	metapath-smoke compress-smoke firehose-smoke lint lint-schema \
+	lint-telemetry tune-smoke lint-tuning tune
 
 # Tier-1: the fast CPU suite (the driver's acceptance gate).
 test:
@@ -116,6 +116,21 @@ obs-smoke:
 # so tier-1 covers it.
 fleet-obs-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) bench_serving.py --regime fleet-obs --smoke
+
+# Firehose smoke: a short sustained delta stream concurrent with
+# closed-loop query load on one warm jax service (background
+# compaction hot-swapping under the swap lock), one FORCED
+# steady-state compaction, a coalesced-update burst through the
+# router's bounded queue, and one deterministic autoscale load step.
+# Hard gates: zero lost requests, zero compiles outside compaction
+# builds, the steady-state compaction probe compiles NOTHING
+# (pow-2 capacity buckets), bounded update-visible p99 and swap
+# pause, broadcasts < updates (coalescing folded), spawn + drain in
+# the decision log. The same run is wired as a non-slow pytest
+# (tests/test_firehose.py::test_bench_firehose_smoke), so tier-1
+# covers it.
+firehose-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) bench_serving.py --regime firehose --smoke
 
 # Metapath planner smoke: the DP chain planner beats the naive
 # left-to-right fold on a measured asymmetric chain (estimated AND

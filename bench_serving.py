@@ -1761,8 +1761,9 @@ def run_smoke(out_path: str | None = None) -> dict:
 
 
 def _partition_worker_argv(spec: str, index: int, partitions: int,
-                           replication: int, k: int) -> list[str]:
-    return [
+                           replication: int, k: int,
+                           trace_out: str | None = None) -> list[str]:
+    argv = [
         sys.executable, "-m", "distributed_pathsim_tpu.cli", "worker",
         "--worker-id", f"w{index}", "--dataset", spec,
         "--backend", "numpy", "--platform", "cpu", "--k", str(k),
@@ -1770,10 +1771,15 @@ def _partition_worker_argv(spec: str, index: int, partitions: int,
         "--partitions", str(partitions),
         "--partition-replication", str(replication),
     ]
+    if trace_out:
+        # enables the worker-side tracer; the span ring is scraped
+        # through the `trace` op for the stitched export
+        argv += ["--trace-out", trace_out, "--trace-sample", "1"]
+    return argv
 
 
 def _spawn_partition_router(partitions: int, replication: int, spec: str,
-                            k: int):
+                            k: int, trace_dir: str | None = None):
     from distributed_pathsim_tpu.router import (
         PartitionRouter, PartitionRouterConfig, SubprocessTransport,
     )
@@ -1781,7 +1787,13 @@ def _spawn_partition_router(partitions: int, replication: int, spec: str,
     transports = {
         f"w{i}": SubprocessTransport(
             f"w{i}",
-            _partition_worker_argv(spec, i, partitions, replication, k),
+            _partition_worker_argv(
+                spec, i, partitions, replication, k,
+                trace_out=(
+                    os.path.join(trace_dir, f"trace.w{i}.json")
+                    if trace_dir else None
+                ),
+            ),
         )
         for i in range(partitions)
     }
@@ -1905,6 +1917,50 @@ def _partition_delta_phase(router, oracle, rng, n_papers, deltas: int,
             router, oracle, rng2, oracle.n, k, samples=8
         ),
     }
+
+
+def _partition_trace_phase(spec: str, partitions: int, replication: int,
+                           k: int, rng, n: int) -> dict:
+    """Partition-aware trace stitching (the PR-11 follow-up): a traced
+    fleet of REAL worker subprocesses, a handful of scatters, one
+    stitched export. The gate: every ``tile_pull``/``partial_topk``
+    sub-request's worker subtree hangs under its router dispatch span
+    — ≥1 stitched cross-process trace, ZERO broken parent links."""
+    import tempfile
+
+    from distributed_pathsim_tpu import obs
+    from distributed_pathsim_tpu.obs import fleet as obs_fleet
+
+    trace_dir = tempfile.mkdtemp(prefix="dpathsim_ptrace_")
+    obs.configure(metrics=True, tracing=True, trace_sample=1)
+    obs.get_tracer().clear()
+    router = _spawn_partition_router(
+        partitions, replication, spec, k, trace_dir=trace_dir,
+    )
+    try:
+        for row in rng.integers(0, n, size=6):
+            resp = router.request(
+                {"op": "topk", "row": int(row), "k": k}, timeout=30,
+            )
+            assert resp.get("ok"), resp
+        resp = router.request(
+            {"op": "scores", "row": int(rng.integers(0, n))}, timeout=30,
+        )
+        assert resp.get("ok"), resp
+        parts = router.collect_trace_parts()
+        audit = obs_fleet.audit_fleet_traces(parts)
+        trace_path = os.path.join(trace_dir, "fleet_trace.json")
+        events = router.write_fleet_trace(trace_path, parts=parts)
+        return {
+            "trace_parts": len(parts),
+            "trace_events": events,
+            "trace_path": trace_path,
+            **audit,
+        }
+    finally:
+        router.close()
+        obs.configure(metrics=True, tracing=False, trace_sample=1)
+        obs.get_tracer().clear()
 
 
 def _partition_kill_phase(spec, partitions, replication, k, uniform,
@@ -2075,6 +2131,11 @@ def run_partition_bench(
                 out["partitions"][str(p_count)] = res
             finally:
                 router.close()
+        # partition-aware trace stitching (PR-11 follow-up): its own
+        # traced fleet so the QPS arms above stay untraced
+        out["trace_stitching"] = _partition_trace_phase(
+            spec, max(max(partitions), 2), replication, k, rng, n,
+        )
         # replica-mode baseline at equal N: the per-query overhead of
         # the tile exchange is partition p50 vs this p50
         rep_router = _spawn_router(2, spec, "numpy", 8, 1.0, k,
@@ -2146,6 +2207,12 @@ def run_partition_smoke(out_path: str | None = None) -> dict:
         "max_n_grows_with_workers": (
             parts["3"]["max_n_at_budget"] > parts["1"]["max_n_at_budget"]
         ),
+        # partition-aware trace stitching (PR-11 follow-up): one
+        # Perfetto tree per scatter, sub-requests included
+        "trace_stitched_zero_broken": (
+            result["trace_stitching"]["broken_parent_links"] == 0
+            and result["trace_stitching"]["stitched_cross_process"] >= 1
+        ),
     }
     result["smoke_checks"] = checks
     if out_path:
@@ -2153,6 +2220,620 @@ def run_partition_smoke(out_path: str | None = None) -> dict:
             json.dump(result, f, indent=2)
     if not all(checks.values()):
         raise AssertionError(f"partition smoke failed: {checks}")
+    return result
+
+
+# ---------------------------------------------------------------------------
+# Firehose regime (--regime firehose): sustained deltas concurrent with
+# closed-loop serving load, background compaction hot-swaps, coalesced
+# fleet updates, and the autoscale load step (BENCH_FIREHOSE artifact;
+# DESIGN.md §30)
+# ---------------------------------------------------------------------------
+
+
+class _DeltaStream:
+    """Deterministic firehose source: tracks its own view of the edge
+    set (seeded from the initial graph), so generated batches are
+    always valid against the service's current graph no matter how the
+    service mutates underneath — the generator is the only updater."""
+
+    def __init__(self, hin, seed: int = 0, adds_per_delta: int = 2,
+                 remove_every: int = 3, append_every: int = 4):
+        from distributed_pathsim_tpu.data import delta as dl
+
+        self._dl = dl
+        self.rng = np.random.default_rng(seed)
+        ap = hin.blocks["author_of"]
+        self.n_authors = hin.type_size("author")
+        self.n_papers = hin.type_size("paper")
+        self.materialized = hin.indices["author"].size_override is None
+        self.existing = set(zip(ap.rows.tolist(), ap.cols.tolist()))
+        self.our_adds: list[tuple[int, int]] = []
+        self.adds_per_delta = adds_per_delta
+        self.remove_every = remove_every
+        self.append_every = append_every
+        self.seq = 0
+
+    def next(self):
+        dl = self._dl
+        self.seq += 1
+        adds = []
+        while len(adds) < self.adds_per_delta:
+            e = (int(self.rng.integers(0, self.n_authors)),
+                 int(self.rng.integers(0, self.n_papers)))
+            if e not in self.existing:
+                self.existing.add(e)
+                adds.append(e)
+        removes = []
+        if self.remove_every and self.seq % self.remove_every == 0 and (
+            self.our_adds
+        ):
+            # remove only edges WE added (never racing the base graph)
+            e = self.our_adds.pop(
+                int(self.rng.integers(0, len(self.our_adds)))
+            )
+            self.existing.discard(e)
+            removes.append(e)
+        nodes = ()
+        if self.append_every and self.seq % self.append_every == 0:
+            if self.materialized:
+                nodes = (dl.NodeAppend(
+                    node_type="author",
+                    ids=(f"fh_author_{self.n_authors}",),
+                ),)
+            else:
+                nodes = (dl.NodeAppend(node_type="author", count=1),)
+            # wire the appended author in so it has a score row (and
+            # RECORD the edge — a later random add may land on this
+            # row once n_authors includes it)
+            wire = (self.n_authors,
+                    int(self.rng.integers(0, self.n_papers)))
+            self.existing.add(wire)
+            adds.append(wire)
+            self.n_authors += 1
+        self.our_adds.extend(adds)
+        return dl.DeltaBatch(
+            edges=(dl.edge_delta("author_of", add=adds, remove=removes),),
+            nodes=nodes,
+        )
+
+
+def _firehose_single_phase(
+    n_authors: int, n_papers: int, n_venues: int, deltas: int,
+    clients: int, backend: str, k: int, chain_len: int,
+    headroom: float = 0.25, update_sleep_ms: float = 0.0, seed: int = 0,
+) -> tuple[dict, object]:
+    """ONE warm service under a sustained delta stream concurrent with
+    closed-loop query load. Returns (measurements, service) — the
+    caller owns the service (steady-state compaction probe + close).
+
+    Measured: sustained updates/sec and query QPS over the same wall
+    window, update-visible latency (update submitted → fresh answer
+    for an affected row returned; the cache purge makes the re-score
+    real), compaction count/pause/build/compile accounting, and the
+    whole-window compile ledger split into compaction-attributed vs
+    everything else (the steady-state gate)."""
+    from distributed_pathsim_tpu.backends.base import create_backend
+    from distributed_pathsim_tpu.data import delta as dl
+    from distributed_pathsim_tpu.obs.metrics import get_registry
+    from distributed_pathsim_tpu.ops.metapath import compile_metapath
+    from distributed_pathsim_tpu.serving import PathSimService, ServeConfig
+    from distributed_pathsim_tpu.utils.xla_flags import CompileCounter
+
+    hin = dl.with_headroom(
+        synthetic_hin_cached(n_authors, n_papers, n_venues, seed=seed),
+        headroom,
+    )
+    mp = compile_metapath("APVPA", hin.schema)
+    svc = PathSimService(
+        create_backend(backend, hin, mp),
+        config=ServeConfig(
+            max_batch=16, max_wait_ms=0.5, queue_depth=4096,
+            k_default=k, compact_auto=True,
+            compact_chain_len=chain_len, compact_cooldown_s=0.5,
+        ),
+    )
+    stream = _DeltaStream(hin, seed=seed)
+    rng = np.random.default_rng(seed + 1)
+    qrows = rng.integers(0, n_authors, size=4096)
+    stop = threading.Event()
+    visible_lat: list[float] = []
+    q_lats: list[list[float]] = [[] for _ in range(clients)]
+    shed = [0]
+
+    updater_err: list = []
+
+    def updater():
+        try:
+            for _ in range(deltas):
+                delta = stream.next()
+                probe = int(delta.edges[0].add[0][0])
+                t0 = time.perf_counter()
+                svc.update(delta)
+                svc.topk_index(min(probe, svc.n - 1), k=k)
+                visible_lat.append(time.perf_counter() - t0)
+                if update_sleep_ms:
+                    time.sleep(update_sleep_ms / 1e3)
+        except BaseException as exc:  # surfaced below — never silent
+            updater_err.append(exc)
+        finally:
+            stop.set()
+
+    def client(ci: int):
+        from distributed_pathsim_tpu.serving import LoadShedError
+
+        j = ci
+        while not stop.is_set():
+            t0 = time.perf_counter()
+            try:
+                svc.topk_index(int(qrows[j % qrows.shape[0]]), k=k)
+            except LoadShedError:
+                shed[0] += 1
+                j += clients
+                continue
+            q_lats[ci].append(time.perf_counter() - t0)
+            j += clients
+
+    # warm one query + one update so the timed window is steady state
+    svc.topk_index(0, k=k)
+    svc.update(stream.next())
+    reg = get_registry()
+    compaction_compiles0 = reg.counter(
+        "dpathsim_compaction_compiles_total",
+        "XLA compiles attributed to compaction builds",
+    ).labels().value
+    threads = [threading.Thread(target=client, args=(ci,), daemon=True)
+               for ci in range(clients)]
+    t0 = time.perf_counter()
+    with CompileCounter() as cc:
+        ut = threading.Thread(target=updater, daemon=True)
+        ut.start()
+        for t in threads:
+            t.start()
+        ut.join()
+        for t in threads:
+            t.join()
+        # fold any still-running background build into the ledger
+        svc._compactor._done.wait(120.0)
+    wall = time.perf_counter() - t0
+    if updater_err:
+        svc.close()
+        raise AssertionError(
+            f"firehose updater failed after {len(visible_lat)} deltas"
+        ) from updater_err[0]
+    compaction_compiles = reg.counter(
+        "dpathsim_compaction_compiles_total",
+        "XLA compiles attributed to compaction builds",
+    ).labels().value - compaction_compiles0
+    flat = [x for sub in q_lats for x in sub]
+    comp = svc.stats()["compaction"]
+    pause_cell = reg.histogram(
+        "dpathsim_compaction_pause_seconds",
+        "swap-lock hold (drain + delta replay + install) per swap",
+    ).labels()
+    out = {
+        "deltas": len(visible_lat),
+        "clients": clients,
+        "wall_s": round(wall, 3),
+        "updates_per_s": round(len(visible_lat) / wall, 2),
+        "qps": round(len(flat) / wall, 2) if wall > 0 else 0.0,
+        "queries": len(flat),
+        "shed": shed[0],
+        "update_visible": _percentiles(visible_lat),
+        "query": _percentiles(flat) if flat else {},
+        "compaction": {
+            "count": comp["compactions"],
+            "abandoned": comp["abandoned"],
+            "failures": comp["failures"],
+            "last": comp["last"],
+            "pause_p99_ms": round(pause_cell.quantile(0.99) * 1e3, 3)
+            if pause_cell.count else None,
+            "compiles": compaction_compiles,
+        },
+        "compiles_total": cc.count,
+        "compiles_outside_compaction": cc.count - compaction_compiles,
+        "inline_rebuilds": svc.stats()["delta"]["rebuilds"],
+    }
+    return out, svc
+
+
+_SYNTH_CACHE: dict = {}
+
+
+def synthetic_hin_cached(n_authors, n_papers, n_venues, seed=0):
+    """The firehose arms re-encode the same base graph repeatedly;
+    memoize the synthesis (each caller re-pads its own copy)."""
+    from distributed_pathsim_tpu.data.synthetic import synthetic_hin
+
+    key = (n_authors, n_papers, n_venues, seed)
+    if key not in _SYNTH_CACHE:
+        _SYNTH_CACHE[key] = synthetic_hin(
+            n_authors, n_papers, n_venues, seed=seed,
+            materialize_ids=True,
+        )
+    return _SYNTH_CACHE[key]
+
+
+def _firehose_fleet_phase(n_authors: int, n_papers: int, n_venues: int,
+                          updates: int, k: int, seed: int = 0) -> dict:
+    """Coalesced fleet updates: an in-proc 2-replica router with the
+    bounded update queue, a burst of K concurrent updates plus
+    closed-loop queries. Gates: broadcasts < K (coalescing really
+    folded), zero lost queries, both replicas at the SAME consistency
+    token afterwards, answers bit-identical to an oracle absorbing the
+    identical update stream sequentially."""
+    from distributed_pathsim_tpu.backends.base import create_backend
+    from distributed_pathsim_tpu.data import delta as dl
+    from distributed_pathsim_tpu.ops.metapath import compile_metapath
+    from distributed_pathsim_tpu.router import (
+        InprocTransport, Router, RouterConfig, WorkerRuntime,
+    )
+    from distributed_pathsim_tpu.serving import PathSimService, ServeConfig
+
+    mp = None
+
+    def make_service():
+        nonlocal mp
+        hin = dl.with_headroom(
+            synthetic_hin_cached(n_authors, n_papers, n_venues,
+                                 seed=seed),
+            0.25,
+        )
+        if mp is None:
+            mp = compile_metapath("APVPA", hin.schema)
+        return PathSimService(
+            create_backend("numpy", hin, mp),
+            config=ServeConfig(max_batch=8, max_wait_ms=0.5,
+                               warm=False),
+        )
+
+    transports = {
+        wid: InprocTransport(
+            wid, WorkerRuntime(make_service(), worker_id=wid)
+        )
+        for wid in ("w0", "w1")
+    }
+    router = Router(transports, RouterConfig(
+        heartbeat_interval_s=0.1, heartbeat_miss_limit=50,
+        hedge_ms=None, max_inflight=8192, scrape_interval_s=0,
+        update_queue=max(updates, 16), update_coalesce=8,
+        update_flush_ms=5.0,
+    ))
+    router.start()
+    oracle = make_service()
+    try:
+        hin0 = oracle.hin
+        stream = _DeltaStream(hin0, seed=seed + 7, append_every=0)
+        reqs = []
+        for i in range(updates):
+            batch = stream.next()
+            e = batch.edges[0]
+            reqs.append({
+                "op": "update", "id": f"fh{i}",
+                "add_edges": [
+                    {"rel": "author_of", "src_row": int(r),
+                     "dst_row": int(c)} for r, c in e.add
+                ],
+                "remove_edges": [
+                    {"rel": "author_of", "src_row": int(r),
+                     "dst_row": int(c)} for r, c in e.remove
+                ],
+            })
+        rng = np.random.default_rng(seed)
+        uniform = rng.integers(0, n_authors, size=(4, 24))
+        t0 = time.perf_counter()
+        futs = [router.submit(dict(r)) for r in reqs]
+        qres = _run_router_clients(router, uniform.tolist(), k)
+        results = [f.result(timeout=120) for f in futs]
+        wall = time.perf_counter() - t0
+        for r in reqs:
+            oracle.update(dl.delta_from_records(
+                oracle.hin, add_edges=r["add_edges"],
+                remove_edges=r["remove_edges"],
+            ))
+        ok_updates = sum(1 for r in results if r.get("ok"))
+        st = router.stats()["router"]
+        tokens = {
+            wid: tuple(w["token"]) if w["token"] else None
+            for wid, w in st["workers"].items()
+        }
+        oracle_check = _router_oracle_check(
+            router, oracle, rng, n_authors, k, samples=12
+        )
+        return {
+            "updates": updates,
+            "updates_ok": ok_updates,
+            "wall_s": round(wall, 3),
+            "broadcasts": st["firehose"]["broadcasts"],
+            "coalesced": st["firehose"]["coalesced"],
+            "backpressure": st["firehose"]["backpressure"],
+            "query_load": qres,
+            "worker_tokens": {w: list(t) if t else None
+                              for w, t in tokens.items()},
+            "tokens_agree": len(set(tokens.values())) == 1,
+            "oracle_checked": oracle_check,
+        }
+    finally:
+        router.close()
+        oracle.close()
+        for t in transports.values():
+            t.runtime.service.close()
+
+
+def _firehose_autoscale_phase(n_authors: int, n_papers: int,
+                              n_venues: int, k: int,
+                              seed: int = 0) -> dict:
+    """The deterministic load step: an in-proc fleet starting at ONE
+    worker, the autoscaler ticked explicitly between load stages.
+    Stage 1 (idle) must hold; stage 2 (a sustained async query burst
+    against a deliberately slow-draining worker) must spawn within
+    ``up_consecutive`` high ticks; stage 3 (idle again) must drain
+    back to the floor. The decision log is the artifact."""
+    from distributed_pathsim_tpu.backends.base import create_backend
+    from distributed_pathsim_tpu.data import delta as dl
+    from distributed_pathsim_tpu.ops.metapath import compile_metapath
+    from distributed_pathsim_tpu.router import (
+        AutoscaleConfig, Autoscaler, InprocTransport, Router,
+        RouterConfig, WorkerRuntime,
+    )
+    from distributed_pathsim_tpu.serving import PathSimService, ServeConfig
+
+    mp = None
+
+    def make_transport(wid: str):
+        nonlocal mp
+        hin = dl.with_headroom(
+            synthetic_hin_cached(n_authors, n_papers, n_venues,
+                                 seed=seed),
+            0.25,
+        )
+        if mp is None:
+            mp = compile_metapath("APVPA", hin.schema)
+        svc = PathSimService(
+            create_backend("numpy", hin, mp),
+            # slow drain under burst: small batches + a real linger +
+            # caches OFF (a 256-row pool would turn pure-LRU-hit in
+            # one wave), so the queue-depth signal is unambiguous
+            config=ServeConfig(max_batch=4, max_wait_ms=20.0,
+                               queue_depth=4096, warm=False,
+                               cache_entries=0, tile_cache_bytes=0),
+        )
+        t = InprocTransport(wid, WorkerRuntime(svc, worker_id=wid))
+        made.append(t)
+        return t
+
+    made: list = []
+    transports = {"w0": make_transport("w0")}
+    router = Router(transports, RouterConfig(
+        heartbeat_interval_s=0.05, heartbeat_miss_limit=100,
+        hedge_ms=None, max_inflight=16384, scrape_interval_s=0,
+        worker_queue_limit=4096, retain_replay=True,
+    ))
+    router.start()
+    auto = Autoscaler(router, make_transport, AutoscaleConfig(
+        min_workers=1, max_workers=3, up_consecutive=2,
+        down_consecutive=3, cooldown_ticks=2,
+        pending_high=48.0, pending_low=2.0,
+    ))
+    rng = np.random.default_rng(seed)
+    try:
+        # stage 1: idle ticks — must hold at the floor
+        idle = [auto.tick()["action"] for _ in range(3)]
+        # stage 2: the load step — each wave submits a 64-query burst
+        # and ticks while the backlog is live (the router's OWN
+        # pending table is the signal: synchronous, deterministic)
+        futs = []
+        spawn_tick = None
+        for wave in range(30):
+            for row in rng.integers(0, n_authors, size=64):
+                futs.append(router.submit(
+                    {"op": "topk", "row": int(row), "k": k}
+                ))
+            d = auto.tick()
+            if d["action"] == "spawn":
+                spawn_tick = d["tick"]
+                break
+        for f in futs:
+            resp = f.result(timeout=120)
+            assert resp.get("ok") or resp.get("shed"), resp
+        # stage 3: idle again — must drain back to the floor
+        drain_tick = None
+        for _ in range(12):
+            time.sleep(0.12)
+            d = auto.tick()
+            if d["action"] == "drain":
+                drain_tick = d["tick"]
+                break
+        # settle: the drained worker exits and is reaped
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            router.reap_workers()
+            with router._lock:
+                n_up = sum(
+                    1 for w in router.workers.values()
+                    if w.status == "up"
+                )
+            if n_up == 1:
+                break
+            time.sleep(0.05)
+        post = router.request(
+            {"op": "topk", "row": 3, "k": k}, timeout=30
+        )
+        return {
+            "idle_actions": idle,
+            "spawn_tick": spawn_tick,
+            "drain_tick": drain_tick,
+            "workers_after_settle": n_up,
+            "post_scale_ok": bool(post.get("ok")),
+            "decisions": [
+                {kk: d[kk] for kk in ("tick", "action", "reason")}
+                for d in auto.decisions
+            ],
+        }
+    finally:
+        router.close()
+        for t in made:
+            t.runtime.service.close()
+
+
+def run_firehose_bench(
+    n_authors: int = 512,
+    n_papers: int = 1024,
+    n_venues: int = 16,
+    deltas: int = 10_000,
+    clients: int = 8,
+    k: int = 10,
+    backend: str = "jax",
+    chain_len: int = 64,
+    frontier_sleeps_ms: tuple = (0.0, 2.0, 10.0),
+    fleet_updates: int = 48,
+    seed: int = 0,
+) -> dict:
+    """``--regime firehose`` (ISSUE 15 / ROADMAP item 3): the fleet
+    under a continuous update stream concurrent with closed-loop
+    serving load. Four phases:
+
+    1. **sustained**: one warm service, ``deltas`` updates back to
+       back against ``clients`` closed-loop queriers — updates/sec,
+       QPS, update-visible p99, ≥1 background compaction hot-swap
+       with measured pause, compile ledger split compaction vs rest;
+       plus a steady-state compaction probe (a forced re-encode at
+       unchanged capacity must add ZERO compiles — the pow-2 bucket
+       contract).
+    2. **frontier**: the same workload at throttled update rates —
+       the sustained updates/sec × QPS trade.
+    3. **fleet**: coalesced updates through the router's bounded
+       queue (broadcasts < K, tokens agree, oracle-exact).
+    4. **autoscale**: the deterministic load step (spawn within the
+       hysteresis bound, drain back at idle, decision log)."""
+    out: dict = {
+        "graph": {"authors": n_authors, "papers": n_papers,
+                  "venues": n_venues, "seed": seed},
+        "load": {"deltas": deltas, "clients": clients, "k": k,
+                 "chain_len": chain_len},
+        "backend": backend,
+        "environment": {
+            "cpu_count": os.cpu_count(),
+            "note": (
+                "updater and query clients share one box with the "
+                "service; updates/sec and QPS here measure the "
+                "CONTENTION point, not isolated ceilings. The "
+                "load-invariant claims are the gates: zero lost, "
+                "zero non-compaction compiles, zero steady-state "
+                "compaction compiles, bounded swap pause."
+            ),
+        },
+    }
+    sustained, svc = _firehose_single_phase(
+        n_authors, n_papers, n_venues, deltas, clients, backend, k,
+        chain_len, seed=seed,
+    )
+    try:
+        # steady-state compaction probe: same capacity → the build
+        # re-dispatches cached executables, compiling NOTHING
+        pre_cap = dict(
+            (svc.stats()["compaction"]["last"].get("capacity") or {})
+        )
+        probe = svc.compact()
+        sustained["steady_compact_probe"] = {
+            "swapped": probe.get("swapped"),
+            "compiles": probe.get("compiles"),
+            "capacity_unchanged": (
+                probe.get("capacity") == pre_cap or not pre_cap
+            ),
+            "pause_ms": probe.get("pause_ms"),
+        }
+    finally:
+        svc.close()
+    out["sustained"] = sustained
+    frontier = []
+    for sleep_ms in frontier_sleeps_ms:
+        if sleep_ms == 0.0:
+            frontier.append({
+                "update_sleep_ms": 0.0,
+                "updates_per_s": sustained["updates_per_s"],
+                "qps": sustained["qps"],
+                "update_visible_p99_ms":
+                    sustained["update_visible"]["p99_ms"],
+            })
+            continue
+        point, svc2 = _firehose_single_phase(
+            n_authors, n_papers, n_venues,
+            max(deltas // 10, 50), clients, backend, k, chain_len,
+            update_sleep_ms=sleep_ms, seed=seed,
+        )
+        svc2.close()
+        frontier.append({
+            "update_sleep_ms": sleep_ms,
+            "updates_per_s": point["updates_per_s"],
+            "qps": point["qps"],
+            "update_visible_p99_ms": point["update_visible"]["p99_ms"],
+        })
+    out["frontier"] = frontier
+    out["fleet"] = _firehose_fleet_phase(
+        n_authors, n_papers, n_venues, fleet_updates, k, seed=seed,
+    )
+    out["autoscale"] = _firehose_autoscale_phase(
+        n_authors, n_papers, n_venues, k, seed=seed,
+    )
+    return out
+
+
+def run_firehose_smoke(out_path: str | None = None) -> dict:
+    """The tier-1 firehose gate (``make firehose-smoke``): a short
+    sustained stream + one forced steady-state compaction + the fleet
+    coalescing burst + one autoscale step. Hard gates: zero lost
+    requests anywhere, every non-compaction compile is zero, ≥1
+    background compaction hot-swap with bounded pause, the
+    steady-state compaction probe compiles NOTHING, update-visible
+    p99 bounded, coalescing really folded broadcasts, and the
+    autoscaler spawned on the load step and drained at idle."""
+    result = run_firehose_bench(
+        n_authors=256, n_papers=448, n_venues=10,
+        deltas=260, clients=4, k=5, chain_len=96,
+        frontier_sleeps_ms=(0.0,), fleet_updates=24,
+    )
+    s = result["sustained"]
+    fleet = result["fleet"]
+    auto = result["autoscale"]
+    checks = {
+        "zero_query_sheds_single": s["shed"] == 0,
+        "updates_all_visible": s["update_visible"]["p99_ms"] is not None,
+        "update_visible_p99_bounded":
+            s["update_visible"]["p99_ms"] < 2000.0,
+        "compaction_happened": s["compaction"]["count"] >= 1,
+        "compaction_pause_bounded": (
+            s["compaction"]["pause_p99_ms"] is not None
+            and s["compaction"]["pause_p99_ms"] < 2000.0
+        ),
+        "zero_compiles_outside_compaction":
+            s["compiles_outside_compaction"] == 0,
+        "steady_compaction_zero_compiles": (
+            s["steady_compact_probe"]["swapped"]
+            and s["steady_compact_probe"]["compiles"] == 0
+            and s["steady_compact_probe"]["capacity_unchanged"]
+        ),
+        "zero_inline_rebuilds": s["inline_rebuilds"] == 0,
+        "fleet_zero_lost": fleet["query_load"]["lost"] == 0,
+        "fleet_updates_all_ok":
+            fleet["updates_ok"] == fleet["updates"],
+        "fleet_coalesced": fleet["broadcasts"] < fleet["updates"],
+        "fleet_tokens_agree": fleet["tokens_agree"],
+        "fleet_oracle_exact":
+            fleet["oracle_checked"]["mismatches"] == 0,
+        "autoscale_spawned": auto["spawn_tick"] is not None,
+        "autoscale_drained": auto["drain_tick"] is not None,
+        "autoscale_settled": auto["workers_after_settle"] == 1,
+        "autoscale_idle_held": all(
+            a == "hold" for a in auto["idle_actions"]
+        ),
+    }
+    result["smoke_checks"] = checks
+    if out_path:
+        with open(out_path, "w", encoding="utf-8") as f:
+            json.dump(result, f, indent=2)
+    if not all(checks.values()):
+        raise AssertionError(f"firehose smoke failed: {checks}")
     return result
 
 
@@ -2765,7 +3446,7 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--regime", default="load",
                    choices=("load", "update", "obs", "router", "ann",
                             "fleet-obs", "partition", "metapath",
-                            "compress"),
+                            "compress", "firehose"),
                    help="'load': the closed-loop QPS regimes; 'update': "
                    "delta-ingestion vs reload latency; 'obs': "
                    "observability overhead (obs on vs off, steady "
@@ -2776,7 +3457,12 @@ def main(argv: list[str] | None = None) -> int:
                    "'fleet-obs': fleet observability overhead arms "
                    "(off / metrics / stitched tracing / tail "
                    "recording) + the cross-process stitching smoke "
-                   "(BENCH_FLEET_OBS artifact)")
+                   "(BENCH_FLEET_OBS artifact); 'firehose': sustained "
+                   "update stream x serving load with background "
+                   "compaction, coalesced fleet updates, and the "
+                   "autoscale load step (BENCH_FIREHOSE artifact)")
+    p.add_argument("--deltas", type=int, default=10_000,
+                   help="firehose regime: sustained updates in phase 1")
     p.add_argument("--replicas", default="1,2,4",
                    help="router regime: comma-separated worker counts")
     p.add_argument("--edge-frac", type=float, default=0.01,
@@ -2798,7 +3484,20 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--out", default=None, help="write the JSON here")
     args = p.parse_args(argv)
 
-    if args.regime == "metapath":
+    if args.regime == "firehose":
+        if args.smoke:
+            result = run_firehose_smoke(args.out)
+        else:
+            result = run_firehose_bench(
+                n_authors=args.authors, n_papers=args.papers,
+                n_venues=args.venues, deltas=args.deltas,
+                clients=args.clients, k=args.k, backend=args.backend,
+                seed=args.seed,
+            )
+            if args.out:
+                with open(args.out, "w", encoding="utf-8") as f:
+                    json.dump(result, f, indent=2)
+    elif args.regime == "metapath":
         if args.smoke:
             result = run_metapath_smoke(args.out)
         else:

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import dataclasses
 
+from .compaction_rules import CompactionDoorwayPass
 from .compress_rules import CompressedLayoutPass
 from .determinism import DeterminismPass
 from .exceptions import ExceptionSafetyPass
@@ -50,6 +51,7 @@ PASS_FAMILIES: dict[str, str] = {
     "MetapathIRPass": "metapath planner IR, interprocedural (MP)",
     "CompressedLayoutPass": "compressed factor layouts, "
                             "interprocedural (CF)",
+    "CompactionDoorwayPass": "compaction swap doorway (CP)",
 }
 
 ALL_PASSES = (
@@ -65,6 +67,7 @@ ALL_PASSES = (
     ExceptionSafetyPass(),
     MetapathIRPass(),
     CompressedLayoutPass(),
+    CompactionDoorwayPass(),
 )
 
 RULES: dict[str, RuleDoc] = {}

@@ -479,6 +479,101 @@ def plan_delta(
 
 
 # ---------------------------------------------------------------------------
+# Coalescing (the firehose batching primitive)
+# ---------------------------------------------------------------------------
+
+
+class NotCoalescable(ValueError):
+    """The batches cannot fold into one (a within-window conflict —
+    e.g. the same edge added twice — that only sequential application
+    can express). Callers fall back to applying them one by one."""
+
+
+def coalesce_deltas(batches) -> DeltaBatch:
+    """Fold K *sequentially valid* delta batches into ONE batch whose
+    application produces the identical graph (the router's firehose
+    batching: the product-rule ΔC composes, so K broadcasts become
+    one). Edge changes cancel pairwise — ``add e`` then ``remove e``
+    (or remove then re-add) nets to nothing, which is exactly what the
+    sequential chain produces — and node appends concatenate in order
+    (later batches' edges may reference earlier batches' appends).
+
+    Raises :class:`NotCoalescable` on transitions a single batch
+    cannot express (add-after-add, remove-after-remove of one edge, or
+    colliding appended ids): such sequences were invalid sequentially
+    anyway, or need the window split. Bit-exactness of the coalesced
+    result vs the sequential chain is property-tested across all four
+    backends (tests/test_firehose.py)."""
+    batches = list(batches)
+    if not batches:
+        return DeltaBatch()
+    if len(batches) == 1:
+        return batches[0]
+    appends: dict[str, dict] = {}  # type → {"ids": [...], "labels": [...], "count": n}
+    seen_ids: dict[str, set] = {}
+    net: dict[str, dict[tuple[int, int], int]] = {}
+    for batch in batches:
+        for a in batch.nodes:
+            slot = appends.setdefault(
+                a.node_type, {"ids": [], "labels": [], "count": 0}
+            )
+            if a.ids:
+                ids_seen = seen_ids.setdefault(a.node_type, set())
+                for i in a.ids:
+                    if i in ids_seen:
+                        raise NotCoalescable(
+                            f"node id {i!r} appended twice in window"
+                        )
+                    ids_seen.add(i)
+                slot["ids"].extend(a.ids)
+                slot["labels"].extend(a.labels or a.ids)
+            else:
+                slot["count"] += a.count
+        for e in batch.edges:
+            m = net.setdefault(e.relationship, {})
+            for pairs, sign in ((e.add, 1), (e.remove, -1)):
+                for row in pairs:
+                    key = (int(row[0]), int(row[1]))
+                    cur = m.get(key, 0)
+                    if cur == sign:
+                        raise NotCoalescable(
+                            f"{e.relationship}: edge {key} "
+                            f"{'added' if sign > 0 else 'removed'} "
+                            "twice in window"
+                        )
+                    if cur == 0:
+                        m[key] = sign
+                    else:
+                        del m[key]  # add+remove (either order) cancels
+    for t, slot in appends.items():
+        if slot["ids"] and slot["count"]:
+            # a type is either materialized (id appends) or implicit
+            # (count appends); a window mixing them was invalid
+            # sequentially too — refuse rather than drop either half
+            raise NotCoalescable(f"type {t!r} mixes id and count appends")
+    nodes = tuple(
+        NodeAppend(
+            node_type=t,
+            ids=tuple(slot["ids"]),
+            labels=tuple(slot["labels"]),
+            count=slot["count"] if not slot["ids"] else 0,
+        )
+        for t, slot in appends.items()
+        if slot["ids"] or slot["count"]
+    )
+    edges = tuple(
+        edge_delta(
+            rel,
+            add=[k for k, s in m.items() if s > 0],
+            remove=[k for k, s in m.items() if s < 0],
+        )
+        for rel, m in sorted(net.items())
+        if m
+    )
+    return DeltaBatch(edges=edges, nodes=nodes)
+
+
+# ---------------------------------------------------------------------------
 # Wire-format construction (the JSONL ``update`` op)
 # ---------------------------------------------------------------------------
 

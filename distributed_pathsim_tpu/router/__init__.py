@@ -18,6 +18,14 @@ ROADMAP open item 4). Public surface:
 - :class:`SubprocessTransport` / :class:`InprocTransport` — how the
   router reaches a worker: a real ``dpathsim worker`` child process, or
   an in-process thread for deterministic chaos tests (transport.py);
+- :class:`Autoscaler` / :class:`AutoscaleConfig` — the closed loop:
+  queue-depth / shed / SLO-burn signals drive worker spawn (epoch
+  catch-up replay) and drain (SIGTERM primitive) with tick-counted
+  hysteresis and a deterministic decision log (autoscale.py,
+  DESIGN.md §30);
+- the firehose update pipeline — bounded update-queue admission with
+  backpressure and delta coalescing (K queued updates folded into one
+  broadcast, firehose.py);
 - the ``dpathsim router`` / ``dpathsim worker`` / ``dpathsim
   fleet-stats`` subcommands (cli.py).
 
@@ -29,6 +37,7 @@ tail-sampled flight recorder for slow/errored/shed/hedged/failed-over
 requests (``flight_dump`` op + SIGTERM drain dump).
 """
 
+from .autoscale import AutoscaleConfig, Autoscaler
 from .core import Router, RouterConfig, RouterShed
 from .hashring import HashRing, RangeRouter, make_policy
 from .partition import PartitionRouter, PartitionRouterConfig
@@ -36,6 +45,8 @@ from .transport import InprocTransport, SubprocessTransport, WorkerGone
 from .worker import WorkerRuntime, worker_loop
 
 __all__ = [
+    "AutoscaleConfig",
+    "Autoscaler",
     "HashRing",
     "InprocTransport",
     "PartitionRouter",

@@ -176,6 +176,11 @@ def _build_worker_service(args):
         ann_auto_refresh=not args.no_ann_refresh,
         memo_budget_mb=args.memo_budget_mb,
         max_metapaths=args.max_metapaths,
+        compact_auto=not args.no_compact,
+        compact_chain_len=args.compact_chain_len,
+        compact_headroom_frac=args.compact_headroom_frac,
+        compact_headroom=args.compact_headroom,
+        compact_cooldown_s=args.compact_cooldown,
     )
     if args.dataset.startswith("synthetic:"):
         from ..backends.base import create_backend
@@ -281,10 +286,12 @@ _FORWARD_VALUE = (
     "tuning_table", "topk_mode", "index", "ann_nprobe", "ann_cand_mult",
     "ann_centroids", "ann_cluster_cap", "ann_variant",
     "ann_shadow_every", "metrics_interval", "trace_sample",
-    "factor_format",
+    "factor_format", "compact_chain_len", "compact_headroom_frac",
+    "compact_headroom", "compact_cooldown",
 )
 _FORWARD_TRUE = (
     "no_warm", "no_metrics", "no_tuning", "approx", "no_ann_refresh",
+    "no_compact",
 )
 # artifact-path flags forwarded with a per-worker suffix: a fleet run
 # with --metrics-file/--trace-out/--metrics must leave N+1 artifacts
@@ -360,6 +367,29 @@ def build_router_parser() -> argparse.ArgumentParser:
                    help="write the flight recording (records + kept "
                    "span trees) here at drain/SIGTERM; the in-band "
                    "'flight_dump' op dumps on demand")
+    # -- firehose update pipelining (DESIGN.md §30) --------------------
+    p.add_argument("--update-queue", type=int, default=0,
+                   help="bounded update-queue admission: queue up to "
+                   "this many updates for the coalescing pump; past "
+                   "the bound submitters get an immediate "
+                   "'backpressure' error (0 = legacy one-broadcast-"
+                   "per-update)")
+    p.add_argument("--update-coalesce", type=int, default=8,
+                   help="max queued updates folded into ONE broadcast "
+                   "(conflicting windows split automatically)")
+    p.add_argument("--update-flush-ms", type=float, default=5.0,
+                   help="how long the pump lingers for more queued "
+                   "updates before broadcasting")
+    # -- closed-loop autoscale (router/autoscale.py) -------------------
+    p.add_argument("--autoscale", action="store_true",
+                   help="let queue-depth / shed / SLO-burn signals "
+                   "spawn and drain workers between --workers (the "
+                   "floor) and --max-workers; implies epoch-replay "
+                   "retention so spawned workers can catch up")
+    p.add_argument("--max-workers", type=int, default=None,
+                   help="autoscale ceiling (default: 2x --workers)")
+    p.add_argument("--autoscale-interval", type=float, default=1.0,
+                   help="seconds between autoscale signal evaluations")
     return p
 
 
@@ -497,6 +527,12 @@ def router_main(argv: list[str] | None = None) -> int:
                 slo_specs=slo_specs,
                 slow_ms=args.slow_ms,
                 flight_capacity=args.flight_capacity,
+                update_queue=args.update_queue,
+                update_coalesce=args.update_coalesce,
+                update_flush_ms=args.update_flush_ms,
+                # spawned workers boot the base graph and catch up by
+                # replaying the epoch log — it must stay replayable
+                retain_replay=args.autoscale,
             ),
         )
     # drain-time artifacts: written by Router.drain() while the
@@ -516,10 +552,29 @@ def router_main(argv: list[str] | None = None) -> int:
         if args.metrics_file
         else None
     )
+    autoscaler = None
+    if args.autoscale and not partition_mode:
+        from .autoscale import AutoscaleConfig, Autoscaler
+
+        autoscaler = Autoscaler(
+            router,
+            # spawned replicas run the exact argv the seed fleet used
+            # (the autoscaler always mints fresh w<N> ids)
+            lambda wid: SubprocessTransport(
+                wid, _worker_argv(args, int(wid[1:]))
+            ),
+            AutoscaleConfig(
+                min_workers=args.workers,
+                max_workers=args.max_workers or 2 * args.workers,
+                eval_interval_s=args.autoscale_interval,
+            ),
+        )
     try:
         router.start()
         if exporter is not None:
             exporter.start()
+        if autoscaler is not None:
+            autoscaler.start()
         print(
             f"router: {args.workers} workers, routing={args.routing}, "
             f"n={router.n}; JSONL on stdin",
@@ -528,6 +583,8 @@ def router_main(argv: list[str] | None = None) -> int:
         return router_loop(router, sys.stdin, sys.stdout)
     finally:
         runtime_event("router_exit", echo=False)
+        if autoscaler is not None:
+            autoscaler.stop()
         # a loop that exited without drain (EOF already drains; an
         # exception might not) still owes the shutdown artifacts
         router._shutdown_dumps()

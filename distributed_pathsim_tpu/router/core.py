@@ -84,6 +84,7 @@ ROUTED_OPS = frozenset({"topk", "scores"})
 UP = "up"
 SUSPECT = "suspect"      # heartbeat-missed: routed around, resurrectable
 DOWN = "down"            # transport-dead: gone for good
+DRAINING = "draining"    # autoscale drain: routed around, never readmitted
 
 
 class RouterShed(RuntimeError):
@@ -125,6 +126,22 @@ class RouterConfig:
     flight_capacity: int = 256
     # span-ring scrape bound per worker (trace op payload)
     trace_scrape_limit: int = 20_000
+    # -- firehose update pipelining (router/firehose.py, DESIGN.md §30)
+    # bounded update-queue admission: >0 routes ``update`` ops through
+    # the coalescing pump; past the bound submitters get an immediate
+    # ``backpressure`` error (the update-side shed signal). 0 keeps
+    # the legacy one-broadcast-per-update path.
+    update_queue: int = 0
+    # max queued updates folded into ONE broadcast (the product-rule
+    # ΔC composes; conflicting windows split automatically)
+    update_coalesce: int = 8
+    # how long the pump lingers for more queued updates before
+    # broadcasting what it has
+    update_flush_ms: float = 5.0
+    # keep every epoch's replay payload even after all live replicas
+    # pass it — required for autoscale: a freshly SPAWNED worker boots
+    # the base graph and must replay the full epoch chain to catch up
+    retain_replay: bool = False
 
 
 class _WorkerState:
@@ -253,6 +270,16 @@ class Router:
         self._draining = False
         self._closed = threading.Event()
         self._maintenance: threading.Thread | None = None
+        # firehose update queue (config.update_queue > 0): submissions
+        # land here; the pump thread drains, coalesces, broadcasts.
+        # Guarded by _uq_cv's lock (its own leaf lock — the pump must
+        # be able to block for arrivals without holding _lock).
+        self._uq_cv = threading.Condition()
+        self._uq: list[tuple[dict, Future]] = []
+        self._uq_pump: threading.Thread | None = None
+        self.updates_coalesced = 0   # updates folded into fewer wires
+        self.update_broadcasts = 0   # coalesced broadcasts sent
+        self.update_backpressure = 0
         self.policy = None
         self.n = 0
         # counters (per-process registry; the router is one per process)
@@ -280,6 +307,25 @@ class Router:
             "dpathsim_router_request_seconds",
             "router submit-to-resolve latency by outcome",
         )
+        # firehose plane: queue depth is the autoscale/backpressure
+        # signal, the coalesce counters are the pipelining evidence
+        self._m_uq_depth = reg.gauge(
+            "dpathsim_update_queue_depth",
+            "updates admitted but not yet broadcast",
+        ).labels()
+        self._m_uq_backpressure = reg.counter(
+            "dpathsim_update_backpressure_total",
+            "updates refused at the queue bound",
+        ).labels()
+        self._m_uq_coalesced = reg.counter(
+            "dpathsim_updates_coalesced_total",
+            "updates folded into a shared broadcast",
+        ).labels()
+        self._m_uq_group = reg.histogram(
+            "dpathsim_update_group_size",
+            "updates per coalesced broadcast",
+            bounds=tuple(float(1 << i) for i in range(9)),
+        ).labels()
         # -- fleet observability plane (DESIGN.md §24) ------------------
         # SLO engine over the merged metric stream; alerts surface as
         # counters/gauges (inside the engine) AND router log events
@@ -307,10 +353,15 @@ class Router:
     # -- lifecycle ---------------------------------------------------------
 
     def start(self, ready_timeout: float = 180.0) -> None:
-        for w in self.workers.values():
+        # membership is mutable under the lock (add/remove/reap_worker)
+        # — snapshot the seed set; nothing else can mutate it before
+        # start() returns, but the discipline is uniform
+        with self._lock:
+            seed = list(self.workers.values())
+        for w in seed:
             w.transport.start(self._on_message, self._on_death)
         tokens = {}
-        for w in self.workers.values():
+        for w in seed:
             info = w.transport.wait_ready(ready_timeout)
             tokens[w.wid] = (info.get("base_fp"), int(info.get("delta_seq", 0)))
             w.token = tokens[w.wid]
@@ -331,25 +382,31 @@ class Router:
         # and counting it as silence would mark every worker stalled
         # on the first probe
         now = time.monotonic()
-        for w in self.workers.values():
+        for w in seed:
             w.last_pong = now
-        self.policy = make_policy(
-            self.config.routing, list(self.workers), n_rows=max(self.n, 1),
-            vnodes=self.config.vnodes,
-        )
+        with self._lock:
+            self._rebuild_policy()
         self._maintenance = threading.Thread(
             target=self._maintenance_loop, name="pathsim-router-maint",
             daemon=True,
         )
         self._maintenance.start()
+        if self.config.update_queue > 0:
+            self._uq_pump = threading.Thread(
+                target=self._update_pump, name="pathsim-router-updates",
+                daemon=True,
+            )
+            self._uq_pump.start()
         runtime_event(
-            "router_ready", workers=len(self.workers), n=self.n,
+            "router_ready", workers=len(seed), n=self.n,
             routing=self.config.routing, fingerprint=base[0],
         )
 
     def close(self) -> None:
         self._closed.set()
-        for w in self.workers.values():
+        with self._lock:
+            targets = list(self.workers.values())
+        for w in targets:
             w.transport.close()
 
     def drain(self) -> bool:
@@ -366,8 +423,10 @@ class Router:
         while time.monotonic() < deadline:
             with self._lock:
                 pending, updates = len(self._pending), len(self._updates)
-                if not pending and not updates:
-                    break
+            with self._uq_cv:
+                queued = len(self._uq)
+            if not pending and not updates and not queued:
+                break
             time.sleep(0.005)
         else:
             clean = False
@@ -375,7 +434,9 @@ class Router:
         # alive — because the flight/trace artifacts need one last
         # span-ring scrape, and a terminated worker can't answer it
         self._shutdown_dumps()
-        for w in self.workers.values():
+        with self._lock:
+            targets = list(self.workers.values())
+        for w in targets:
             if w.transport.alive:
                 try:
                     w.transport.terminate()
@@ -410,6 +471,8 @@ class Router:
                             "result": self.stats()})
             return fut
         if op == "update":
+            if self.config.update_queue > 0:
+                return self._enqueue_update(req, fut)
             return self._submit_update(req, fut)
         if op == "invalidate":
             return self._submit_invalidate(req, fut)
@@ -483,8 +546,11 @@ class Router:
         (worker_id, reason-if-none)."""
         saturated = fenced = exhausted = 0
         for wid in self.policy.preference(p.key):
-            w = self.workers[wid]
-            if w.status != UP or not w.transport.alive:
+            # the policy can briefly lag membership (reaped workers
+            # stay in the last ring until a live set exists again) —
+            # a missing id is simply not eligible
+            w = self.workers.get(wid)
+            if w is None or w.status != UP or not w.transport.alive:
                 continue
             if wid in exclude:
                 exhausted += 1  # alive, but this request already tried it
@@ -853,7 +919,9 @@ class Router:
 
     def _probe_workers(self, now: float) -> None:
         cfg = self.config
-        for w in list(self.workers.values()):
+        with self._lock:
+            targets = list(self.workers.values())
+        for w in targets:
             if w.status == DOWN or not w.transport.alive:
                 continue
             try:
@@ -930,6 +998,11 @@ class Router:
         which only a divergent (epoch −1) replica would ever consult —
         and None means "all rows", exactly the conservative fence such
         a replica already gets."""
+        if self.config.retain_replay:
+            # autoscale mode: a spawned worker boots the base graph
+            # (epoch 0) and catches up by replaying the WHOLE chain —
+            # compacting any payload would strand it fenced forever
+            return
         live = [
             w.epoch for w in self.workers.values()
             if w.status != DOWN and w.epoch >= 0
@@ -1024,6 +1097,138 @@ class Router:
                 except (inject.InjectedFault, WorkerGone) as exc:
                     self._update_failure(urid, w.wid, repr(exc))
         return fut
+
+    # -- firehose update pipelining (router/firehose.py) -------------------
+
+    def _enqueue_update(self, req: dict, fut: Future) -> Future:
+        """Bounded admission for the firehose path: queue the update
+        for the coalescing pump, or refuse immediately with a
+        ``backpressure`` error — the update-side twin of query shed."""
+        with self._uq_cv:
+            if self._closed.is_set():
+                fut.set_result({
+                    "id": req.get("id"), "ok": False,
+                    "error": "draining", "draining": True,
+                })
+                return fut
+            if len(self._uq) >= self.config.update_queue:
+                self.update_backpressure += 1
+                self._m_uq_backpressure.inc()
+                runtime_event(
+                    "router_update_backpressure",
+                    depth=self.config.update_queue, echo=False,
+                )
+                fut.set_result({
+                    "id": req.get("id"), "ok": False,
+                    "error": "update queue full",
+                    "backpressure": True, "shed": True,
+                })
+                return fut
+            self._uq.append((req, fut))
+            self._m_uq_depth.set(len(self._uq))
+            self._uq_cv.notify()
+        return fut
+
+    def _update_pump(self) -> None:
+        """Drain → coalesce → broadcast, strictly in admission order.
+        One pump thread per router, so coalesced broadcasts stay
+        totally ordered (a delta chain applied out of order is a
+        different graph)."""
+        from .firehose import coalesce_update_groups
+
+        flush_s = max(self.config.update_flush_ms, 0.0) / 1e3
+        while not self._closed.is_set():
+            with self._uq_cv:
+                while not self._uq and not self._closed.is_set():
+                    self._uq_cv.wait(0.2)
+                if self._closed.is_set():
+                    break
+            if flush_s:
+                time.sleep(flush_s)  # linger: let the window fill
+            with self._uq_cv:
+                batch = self._uq[:]
+                del self._uq[:]
+                self._m_uq_depth.set(0)
+            if not batch:
+                continue
+            reqs = [r for r, _f in batch]
+            futs = {id(r): f for r, f in batch}
+            for group in coalesce_update_groups(
+                reqs, max(self.config.update_coalesce, 1)
+            ):
+                self._broadcast_group(group, futs)
+        # shutdown: whatever is still queued (enqueued mid-iteration,
+        # or arriving between close() and the enqueue-side closed
+        # check) must be resolved, never left hanging a caller's
+        # fut.result()
+        with self._uq_cv:
+            leftover = self._uq[:]
+            del self._uq[:]
+            self._m_uq_depth.set(0)
+        for req, fut in leftover:
+            if not fut.done():
+                fut.set_result({
+                    "id": req.get("id"), "ok": False,
+                    "error": "draining", "draining": True,
+                })
+
+    def _broadcast_group(self, group, futs: dict) -> None:
+        """One coalesced broadcast; resolves every member future. A
+        merged window the workers reject wholesale (e.g. an id/row
+        aliased edge pair the record-level fold could not cancel)
+        falls back to sequential per-member broadcasts — coalescing is
+        a throughput optimization and must never fail an update the
+        sequential path would have applied."""
+        n = len(group.members)
+        self.update_broadcasts += 1
+        self._m_uq_group.observe(n)
+        if n > 1:
+            self.updates_coalesced += n
+            self._m_uq_coalesced.inc(n)
+
+        def broadcast_one(wire_req: dict) -> dict:
+            inner: Future = Future()
+            self._submit_update(dict(wire_req), inner)
+            try:
+                return inner.result(
+                    timeout=self.config.update_timeout_s + 5.0
+                )
+            except Exception as exc:  # timeout: surface, don't hang
+                return {"ok": False, "error": repr(exc)}
+
+        resp = broadcast_one(group.merged_wire) if n > 1 else (
+            broadcast_one(group.members[0])
+        )
+        if n > 1 and not resp.get("ok"):
+            # fall back to sequential members ONLY on deterministic
+            # wholesale rejection (every replica answered with an
+            # error). An ack TIMEOUT is ambiguous — a slow replica may
+            # yet apply the merge, and re-broadcasting members on top
+            # would double-apply and fork its token off the epoch
+            # history; surface the failure to the members instead.
+            missed = (resp.get("detail") or {}).get("missed") or {}
+            ambiguous = not missed or any(
+                "timeout" in str(v) for v in missed.values()
+            )
+            if not ambiguous:
+                runtime_event(
+                    "router_coalesce_fallback", members=n,
+                    error=str(resp.get("error", "?")),
+                )
+                for req in group.members:
+                    r = broadcast_one(req)
+                    fut = futs.get(id(req))
+                    if fut is not None and not fut.done():
+                        fut.set_result({**r, "id": req.get("id")})
+                return
+        for req in group.members:
+            fut = futs.get(id(req))
+            if fut is not None and not fut.done():
+                out = dict(resp)
+                out["id"] = req.get("id")
+                if n > 1:
+                    out["coalesced"] = n
+                fut.set_result(out)
 
     def _on_update_ack(self, wid: str, rid: str, obj: dict) -> None:
         """An ``update`` response — from the broadcast (``up:``) or a
@@ -1201,7 +1406,9 @@ class Router:
 
     def _submit_invalidate(self, req: dict, fut: Future) -> Future:
         acked = 0
-        for w in list(self.workers.values()):
+        with self._lock:
+            targets = list(self.workers.values())
+        for w in targets:
             if w.status != UP or not w.transport.alive:
                 continue
             try:
@@ -1217,6 +1424,105 @@ class Router:
         })
         return fut
 
+    # -- dynamic membership (router/autoscale.py, DESIGN.md §30) -----------
+
+    def _rebuild_policy(self) -> None:
+        """Re-derive the routing policy over the CURRENT live set —
+        caller holds the lock. Hash-ring membership changes move some
+        rows' affinity (those rows re-warm on their new replica); the
+        fencing/failover machinery is membership-agnostic."""
+        live = [
+            wid for wid, w in self.workers.items()
+            if w.status not in (DOWN, DRAINING)
+        ]
+        if live:
+            self.policy = make_policy(
+                self.config.routing, live, n_rows=max(self.n, 1),
+                vnodes=self.config.vnodes,
+            )
+
+    def add_worker(self, wid: str, transport,
+                   ready_timeout: float = 180.0) -> dict:
+        """Bring one NEW replica into the live fleet (the autoscale
+        spawn primitive): start its transport, wait for ready, verify
+        it serves a token from our epoch history (a fresh boot is
+        epoch 0 — the base graph), register it, and rebuild the
+        routing policy. The worker's first pong triggers the ordered
+        catch-up replay of every missed epoch (idempotent by request
+        id), and it stays fenced from affected rows until caught up —
+        spawning can never serve stale data, only warm up."""
+        transport.start(self._on_message, self._on_death)
+        info = transport.wait_ready(ready_timeout)
+        token = (info.get("base_fp"), int(info.get("delta_seq", 0)))
+        with self._lock:
+            if wid in self.workers:
+                raise ValueError(f"worker id {wid!r} already registered")
+            epoch = self._epoch_of(token)
+            if epoch is None:
+                raise ValueError(
+                    f"spawned worker {wid} serves token {token} outside "
+                    "this router's epoch history — wrong dataset/config"
+                )
+            w = _WorkerState(wid, transport)
+            w.token = token
+            w.epoch = epoch
+            w.last_pong = time.monotonic()
+            self.workers[wid] = w
+            self._rebuild_policy()
+            lag = len(self._epochs) - 1 - epoch
+        runtime_event(
+            "router_worker_added", worker_id=wid, epoch=epoch, lag=lag,
+        )
+        get_registry().counter(
+            "dpathsim_autoscale_workers_added_total",
+            "workers spawned into the live fleet",
+        ).inc()
+        return info
+
+    def remove_worker(self, wid: str) -> bool:
+        """Begin a graceful drain of one replica (the autoscale drain
+        primitive): mark it DRAINING (routed around from this instant,
+        never readmitted), rebuild the policy, and request the drain —
+        SIGTERM for subprocess transports, the in-band ``drain`` op
+        in-proc. In-flight work completes (new queries get retriable
+        ``draining`` errors the failover path reroutes); the clean
+        exit surfaces as transport death, after which
+        :meth:`reap_workers` removes the entry."""
+        with self._lock:
+            w = self.workers.get(wid)
+            if w is None or w.status in (DOWN, DRAINING):
+                return False
+            w.status = DRAINING
+            self._rebuild_policy()
+        runtime_event("router_worker_draining", worker_id=wid)
+        get_registry().counter(
+            "dpathsim_autoscale_workers_drained_total",
+            "workers drained out of the live fleet",
+        ).inc()
+        try:
+            w.transport.terminate()
+        except Exception:
+            pass  # already dead: on_death handles the bookkeeping
+        return True
+
+    def reap_workers(self) -> list[str]:
+        """Drop DOWN workers whose transports are gone (drained or
+        dead) from the table. Autoscale calls this per tick so cycled
+        worker ids don't accumulate; chaos benches that never reap
+        keep their post-mortem state, unchanged."""
+        reaped = []
+        with self._lock:
+            for wid, w in list(self.workers.items()):
+                if w.status == DOWN and not w.transport.alive:
+                    del self.workers[wid]
+                    reaped.append(wid)
+            if reaped:
+                self._rebuild_policy()
+        for wid in reaped:
+            runtime_event("router_worker_reaped", worker_id=wid,
+                          echo=False)
+        return reaped
+
     # -- fleet observability plane (DESIGN.md §24) -------------------------
 
     def _scrape_workers(self) -> None:
@@ -1224,7 +1530,9 @@ class Router:
         ``metrics`` op); replies land in :meth:`_on_metrics`. Send
         failures are the heartbeat path's business — here they are
         simply skipped (the merge uses whatever snapshots exist)."""
-        for w in list(self.workers.values()):
+        with self._lock:
+            targets = list(self.workers.values())
+        for w in targets:
             if w.status == DOWN or not w.transport.alive:
                 continue
             try:
@@ -1348,8 +1656,9 @@ class Router:
         still record that the dispatch happened)."""
         with self._lock:
             seq0 = {w.wid: w.trace_seq for w in self.workers.values()}
+            targets = list(self.workers.values())
         limit = self.config.trace_scrape_limit
-        for w in list(self.workers.values()):
+        for w in targets:
             if w.status == DOWN or not w.transport.alive:
                 continue
             try:
@@ -1445,6 +1754,8 @@ class Router:
         return {}
 
     def stats(self) -> dict:
+        with self._uq_cv:
+            queued = len(self._uq)
         with self._lock:
             head = len(self._epochs) - 1
             return {
@@ -1472,6 +1783,14 @@ class Router:
                     "routing": self.config.routing,
                     "draining": self._draining,
                     "n": self.n,
+                    # firehose pipelining accounting (DESIGN.md §30)
+                    "firehose": {
+                        "update_queue": self.config.update_queue,
+                        "queued": queued,
+                        "coalesced": self.updates_coalesced,
+                        "broadcasts": self.update_broadcasts,
+                        "backpressure": self.update_backpressure,
+                    },
                     "obs": {
                         "slo_alerts": dict(self.slo.alert_counts),
                         "flight_kept": self.flight.kept_total,

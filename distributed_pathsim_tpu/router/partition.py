@@ -47,7 +47,12 @@ Robustness inherits the PR-6 contracts one level down:
   unchanged over the merged stream and judges the worst partition
   through the per-worker scrape), plus per-partition dispatch
   counters; slow/errored/failed-over requests land in the flight
-  recorder.
+  recorder. Every scatter opens a fleet-level root span and every
+  sub-request (``resolve`` / ``tile_pull`` / per-range partials)
+  carries its dispatch span's context on the wire, so a
+  partition-mode request stitches into ONE cross-process Perfetto
+  tree (``collect_trace_parts``/``write_fleet_trace``; zero broken
+  parent links gated in ``make partition-smoke``).
 """
 
 from __future__ import annotations
@@ -66,6 +71,7 @@ from ..obs import fleet as obs_fleet
 from ..obs.flight import FlightRecorder
 from ..obs.metrics import get_registry
 from ..obs.slo import SLOEngine, default_specs
+from ..obs.trace import get_tracer, to_wire
 from ..ops import pathsim
 from ..resilience import Deadline, inject
 from ..utils.logging import runtime_event
@@ -136,10 +142,11 @@ class _Scatter:
     __slots__ = (
         "rid", "req", "op", "future", "row", "k", "deadline", "t0",
         "stage", "tile", "parts", "assigned", "tried", "failovers",
-        "restarts", "parked",
+        "restarts", "parked", "span", "sub_spans",
     )
 
-    def __init__(self, rid, req, op, future, row, k, deadline):
+    def __init__(self, rid, req, op, future, row, k, deadline,
+                 span=None):
         self.rid = rid
         self.req = req
         self.op = op
@@ -156,6 +163,12 @@ class _Scatter:
         self.failovers = 0
         self.restarts = 0
         self.parked = False
+        # tracing: the fleet-level root span and one child span per
+        # sub-request dispatch (resolve / tile_pull / partial per
+        # range), each carried to its worker on the wire so a
+        # partition-mode request renders as ONE Perfetto tree
+        self.span = span
+        self.sub_spans: dict = {}
 
 
 class _Epoch:
@@ -286,12 +299,14 @@ class PartitionRouter:
         self._slow_s = float(slow_ms) / 1e3
         self.flight = FlightRecorder(self.config.flight_capacity)
         self._shutdown_dumped = False
-        # optional shutdown artifact paths (set by the CLI) — partition
-        # mode dumps flight records; fleet trace stitching is the
-        # replicate router's surface (partition scatter spans are a
-        # follow-up, so the attribute exists but stays unwritten)
+        # optional shutdown artifact paths (set by the CLI): flight
+        # records AND the stitched fleet trace — partition scatters
+        # carry trace context on every sub-request wire, so a
+        # partition-mode request is one connected cross-process tree
+        # (the PR-11 follow-up; audited in ``make partition-smoke``)
         self.flight_out: str | None = None
         self.fleet_trace_out: str | None = None
+        self.trace_scrape_limit = 20_000
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -459,26 +474,63 @@ class PartitionRouter:
             time.sleep(0.005)
         else:
             clean = False
+        # dumps BEFORE the workers terminate: the stitched trace needs
+        # one last span-ring scrape, and a drained worker can't answer
+        self._shutdown_dumps()
         for w in self.workers.values():
             if w.transport.alive:
                 try:
                     w.transport.terminate()
                 except Exception:
                     pass
-        self._shutdown_dumps()
         runtime_event("partition_router_drain", clean=clean,
                       pending=pending)
         return clean
+
+    def collect_trace_parts(self, timeout: float = 5.0) -> list[dict]:
+        """The stitched-export inputs: this process's span ring plus a
+        ``trace``-op scrape of every live partition worker (same
+        contract as the replicate router's: a SIGKILLed worker's
+        un-scraped spans are absence, not breakage)."""
+        limit = self.trace_scrape_limit
+        acks, _failures = self._broadcast(
+            {"op": "trace", "limit": limit}, "tr", timeout=timeout,
+        )
+        parts = [{**get_tracer().export_state(limit=limit),
+                  "process": "router"}]
+        for wid in sorted(acks):
+            result = acks[wid].get("result") or {}
+            if "spans" in result:
+                parts.append({**result, "process": f"worker {wid}"})
+        return parts
+
+    def write_fleet_trace(self, path: str,
+                          parts: list[dict] | None = None) -> int:
+        """One stitched Perfetto file for the partition fleet; returns
+        the span-event count."""
+        if parts is None:
+            parts = self.collect_trace_parts()
+        n = obs_fleet.write_fleet_trace(path, parts)
+        runtime_event("fleet_trace_written", path=path, spans=n)
+        return n
 
     def _shutdown_dumps(self) -> None:
         if self._shutdown_dumped:
             return
         self._shutdown_dumped = True
-        if not self.flight_out:
+        if not (self.flight_out or self.fleet_trace_out):
             return
         try:
-            info = self.flight.dump(self.flight_out, [])
-            runtime_event("flight_dump", **info)
+            parts = (
+                self.collect_trace_parts()
+                if get_tracer().enabled and self.fleet_trace_out
+                else []
+            )
+            if self.flight_out:
+                info = self.flight.dump(self.flight_out, parts)
+                runtime_event("flight_dump", **info)
+            if self.fleet_trace_out:
+                self.write_fleet_trace(self.fleet_trace_out, parts=parts)
         except Exception as exc:
             runtime_event("fleet_dump_failed", error=repr(exc))
 
@@ -519,9 +571,15 @@ class PartitionRouter:
             fut.set_result({"id": req.get("id"), "ok": False,
                             "error": f"unknown op {op!r}"})
             return fut
+        # the fleet-level trace root: head sampling decides here, once
+        # for the whole scatter — every sub-request wire propagates it
+        root = get_tracer().start_span(
+            "router.request", op=op, row=req.get("row"), mode="partition",
+        )
         with self._lock:
             if len(self._pending) >= self.config.max_inflight:
                 self._m_requests.inc(outcome="shed")
+                get_tracer().finish(root, outcome="shed")
                 self.flight.keep(["shed"], op=op, row=req.get("row"),
                                  where="admission")
                 raise RouterShed(
@@ -535,7 +593,7 @@ class PartitionRouter:
             deadline = Deadline.from_ms(
                 req.get("deadline_ms", self.config.default_deadline_ms)
             )
-            p = _Scatter(rid, req, op, fut, row, k, deadline)
+            p = _Scatter(rid, req, op, fut, row, k, deadline, span=root)
             self._pending[rid] = p
         self._advance(p)
         return fut
@@ -664,18 +722,40 @@ class PartitionRouter:
             if wid is None:
                 self._park_or_fail(p, why)
                 return False
+            tracer = get_tracer()
             with self._lock:
                 if p.rid not in self._pending:
                     return True
                 w = self.workers[wid]
                 p.tried.setdefault(key, set()).add(wid)
                 p.assigned[key] = wid
+                attempt = None
+                if p.span is not None:
+                    # one span per sub-request dispatch, all siblings
+                    # under the scatter root; a failed-over
+                    # sub-request's earlier span seals as superseded
+                    attempt = tracer.start_span(
+                        "router.dispatch", parent=p.span.context,
+                        worker=wid, sub=str(key), op=wire.get("op"),
+                    )
+                    tracer.finish(
+                        p.sub_spans.pop(key, None), outcome="superseded"
+                    )
+                    p.sub_spans[key] = attempt
             out = dict(wire)
             sub = key if isinstance(key, str) else f"g{key}"
             out["id"] = f"q:{p.rid}:{sub}"
             out["request_id"] = f"{p.rid}.{sub}"
             if p.deadline is not None:
                 out["deadline_ms"] = max(p.deadline.remaining_ms(), 0.0)
+            if tracer.enabled:
+                # the worker's serve.op span parents under THIS
+                # dispatch span; a sampled-out root propagates the
+                # drop so the fleet-wide head rate stays configured
+                out["trace"] = to_wire(
+                    attempt.context if attempt is not None else None,
+                    sampled=attempt is not None,
+                )
             if isinstance(key, int):
                 self._m_part_dispatch.inc(partition=str(key))
             try:
@@ -685,6 +765,9 @@ class PartitionRouter:
                 with self._lock:
                     if p.assigned.get(key) == wid:
                         del p.assigned[key]
+                    tracer.finish(
+                        p.sub_spans.pop(key, None), outcome="send_failed"
+                    )
                 self._mark_down(wid, DOWN, "send failed")
 
     def _park_or_fail(self, p: _Scatter, verdict: str) -> None:
@@ -745,6 +828,10 @@ class PartitionRouter:
             if p.assigned.get(key) != wid:
                 return  # a late answer from a failed-over sub-request
             del p.assigned[key]
+            get_tracer().finish(
+                p.sub_spans.pop(key, None),
+                outcome="ok" if obj.get("ok") else "worker_error",
+            )
         if not obj.get("ok"):
             retriable = bool(
                 obj.get("shed") or obj.get("draining")
@@ -882,9 +969,18 @@ class PartitionRouter:
 
     def _resolve(self, p: _Scatter, resp: dict) -> None:
         elapsed = time.monotonic() - p.t0
+        tracer = get_tracer()
         with self._lock:
             if self._pending.pop(p.rid, None) is None:
                 return
+            stale = list(p.sub_spans.values())
+            p.sub_spans.clear()
+        # seal the trace: outstanding sub-request spans (failed-over
+        # stragglers) close as superseded, then the root carries the
+        # outcome — one complete tree per scatter
+        for span in stale:
+            tracer.finish(span, outcome="superseded")
+        tracer.finish(p.span, outcome="ok" if resp.get("ok") else "error")
         client = dict(resp)
         client["id"] = p.req.get("id")
         client["request_id"] = p.rid

@@ -104,6 +104,34 @@ def build_serve_parser() -> argparse.ArgumentParser:
         "edges rebuild instead of patching",
     )
     p.add_argument(
+        "--no-compact", action="store_true",
+        help="disable automatic background compaction (the 'compact' "
+        "op still works on demand); without it, headroom exhaustion "
+        "falls back to the synchronous inline rebuild",
+    )
+    p.add_argument(
+        "--compact-chain-len", type=int, default=None,
+        help="deltas absorbed since the last re-encode before a "
+        "background compaction triggers (default: the tuned "
+        "compact_chain_len knob)",
+    )
+    p.add_argument(
+        "--compact-headroom-frac", type=float, default=0.10,
+        help="compact when the capacity reserve falls below this "
+        "fraction of the logical size (types that reserved headroom "
+        "at build only)",
+    )
+    p.add_argument(
+        "--compact-headroom", type=float, default=None,
+        help="fresh capacity reserve of a compaction re-encode, as a "
+        "fraction of size, padded to pow-2 (default: the tuned "
+        "compact_headroom knob)",
+    )
+    p.add_argument(
+        "--compact-cooldown", type=float, default=5.0,
+        help="seconds between background compactions",
+    )
+    p.add_argument(
         "--metrics-file", default=None,
         help="Prometheus textfile: counters/gauges/latency histograms "
         "re-written atomically every --metrics-interval (node-exporter "
@@ -252,6 +280,11 @@ def serve_main(argv: list[str] | None = None) -> int:
         ann_auto_refresh=not args.no_ann_refresh,
         memo_budget_mb=args.memo_budget_mb,
         max_metapaths=args.max_metapaths,
+        compact_auto=not args.no_compact,
+        compact_chain_len=args.compact_chain_len,
+        compact_headroom_frac=args.compact_headroom_frac,
+        compact_headroom=args.compact_headroom,
+        compact_cooldown_s=args.compact_cooldown,
     )
     from .. import obs
 

@@ -23,6 +23,7 @@ Requests::
      "deadline_ms": 250.0}
     {"id": 13, "op": "topk", "row": 17, "mode": "ann"}
     {"id": 14, "op": "refresh_index"}
+    {"id": 15, "op": "compact"}
 
 ``topk`` and ``scores`` accept an optional defaulted ``metapath``
 (default: the service's ``--metapath``, itself defaulted to "APVPA"):
@@ -121,7 +122,7 @@ _QUERY_KEYS = ("source", "source_id", "row")
 # being able to correlate its responses.
 PROTOCOL_OPS = frozenset({
     "ping", "stats", "metrics", "health", "invalidate", "topk",
-    "refresh_index", "update", "scores", "trace",
+    "refresh_index", "update", "scores", "trace", "compact",
     # partition-mode exchange ops (DESIGN.md §26): served by
     # PartitionService workers behind `dpathsim router --mode
     # partition`; on a replica service they fail as clean per-request
@@ -238,6 +239,13 @@ def _dispatch_op(
         }
     if op == "refresh_index":
         return service.refresh_index()
+    if op == "compact":
+        # force one background-style compaction synchronously
+        # (serving/compact.py): re-encode with fresh pow-2 headroom,
+        # hot-swap under the swap lock, token and caches preserved.
+        # Idempotent by construction — re-running it re-encodes the
+        # same logical graph — so router retries need no dedup.
+        return service.compact()
     if op == "trace":
         # the span-ring scrape: the router's fleet-trace export and
         # flight-recorder dumps collect each worker's ring through

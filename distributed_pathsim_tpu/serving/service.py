@@ -47,6 +47,18 @@ from .coalescer import BatchStats, Coalescer, Request
 # lane axis" of the metapath workload design, DESIGN.md §28).
 _MP_LANE = "mp:"
 
+# The compaction-swap doorway surface (analysis rule CP001, DESIGN.md
+# §30): these internals perform the token-preserving hot-swap and are
+# only sound inside _apply_compaction under the swap lock with the
+# mid-build replay log in hand. serving/compact.py is the one
+# sanctioned caller; everything else compacts via service.compact() or
+# the 'compact' protocol op. Parsed by the analyzer as a literal, so
+# the rule and this registry cannot drift.
+COMPACTION_SURFACE = frozenset({
+    "_apply_compaction",
+    "_swap_compacted",
+})
+
 
 @dataclasses.dataclass
 class MetapathEngine:
@@ -116,6 +128,25 @@ class ServeConfig:
     # warm backend (device factor + compiled buckets), so the set must
     # not grow with attacker-chosen request fields.
     max_metapaths: int = 8
+    # -- background compaction (serving/compact.py, DESIGN.md §30) -----
+    # Re-encode with fresh pow-2 headroom and hot-swap in the
+    # background when the capacity reserve runs low or the delta chain
+    # grows long — the firehose alternative to the synchronous
+    # headroom-exhausted rebuild. The swap preserves the consistency
+    # token and both cache tiers (the logical graph is unchanged).
+    compact_auto: bool = True
+    # deltas absorbed since the last re-encode before a chain-triggered
+    # compaction (None → the tuned ``compact_chain_len`` knob)
+    compact_chain_len: int | None = None
+    # trigger when min type headroom falls below this fraction of the
+    # logical size (only for types that reserved capacity at build)
+    compact_headroom_frac: float = 0.10
+    # fresh capacity reserve target of the re-encode (None → the tuned
+    # ``compact_headroom`` knob); padded to pow-2 buckets either way
+    compact_headroom: float | None = None
+    compact_cooldown_s: float = 5.0
+    # bounded build retries when deltas keep landing mid-build
+    compact_attempts: int = 3
 
 
 class PathSimService:
@@ -211,6 +242,12 @@ class PathSimService:
             "secondary metapath engines built, by metapath",
         )
         self._install_backend(backend, warm=self.config.warm)
+        # background compaction (serving/compact.py): triggered per
+        # absorbed delta under _swap_lock; built AFTER the first
+        # install so its tuned thresholds see the real n
+        from .compact import Compactor
+
+        self._compactor = Compactor(self)
         self.coalescer = Coalescer(
             issue=self._issue,
             complete=self._complete,
@@ -227,6 +264,12 @@ class PathSimService:
         """Make a backend serving-warm: denominators prefetched (for
         jax backends this also assembles C and leaves it device-
         resident), fingerprint computed, buckets pre-compiled."""
+        # a wholesale install re-bases the consistency token: the
+        # compaction chain restarts and any in-flight build is stale
+        # (absent only during the constructor's first install)
+        compactor = getattr(self, "_compactor", None)
+        if compactor is not None:
+            compactor.note_rebuild()
         self.backend = backend
         self.hin = backend.hin
         self.metapath = backend.metapath
@@ -1077,6 +1120,13 @@ class PathSimService:
             "delta_seq": self._delta_seq,
             "fingerprint": self._fp,
             "backend": self.backend.name,
+            # compaction heartbeat bits: a router (or operator) can see
+            # a replica mid-build — the token above is UNCHANGED by a
+            # compaction swap, so fencing never reacts to one
+            "compaction": {
+                "inflight": self._compactor.inflight,
+                "count": self._compactor.compactions,
+            },
             # index epoch: lets a router (or operator) see which
             # replicas hold a fresh ANN index — a replica without one
             # still answers every query, exactly (None = exact-only)
@@ -1189,6 +1239,10 @@ class PathSimService:
                     affected_list = [int(r) for r in affected]
                 self._update_stats["deltas"] += 1
                 self._update_stats["purged_rows"] += purged
+            # compaction bookkeeping + trigger check (we hold the swap
+            # lock): a patch feeds an in-flight build's replay log; a
+            # long chain or thin headroom spawns the background build
+            self._compactor.note_update(delta, mode)
             ms = round((time.perf_counter() - t0) * 1e3, 3)
             self._m_updates.inc(mode=mode)
             get_registry().histogram(
@@ -1412,6 +1466,99 @@ class PathSimService:
                 to_fingerprint=self._fp,
             )
 
+    def _apply_compaction(self, backend: PathSimBackend, hin_c,
+                          token0: tuple) -> dict:
+        """The compaction-swap doorway: the ONLY path by which a
+        compaction-built backend enters service (serving/compact.py is
+        the sole caller — analyzer-sealed, CP001). Under the swap
+        lock: verify the build's token snapshot still chains to the
+        live token (a reload/rebuild re-based it → abandon), replay
+        the deltas that landed mid-build onto the new backend (O(Δ)
+        each; the build pre-folded the half chain), drain the
+        pipeline, and hot-swap. Returns either ``{"abandoned":
+        reason}`` or the swap accounting (replayed count, pause
+        seconds, new capacities)."""
+        from ..backends.base import DeltaUnsupported
+        from ..data.delta import plan_delta
+
+        comp = self._compactor
+        with self._swap_lock:
+            log = comp._log
+            want = (
+                (token0[0], token0[1] + len(log))
+                if log is not None else None
+            )
+            if want is None or self.consistency_token != want:
+                return {"abandoned": "token moved during build"}
+            t_pause = time.perf_counter()
+            hin_cur = hin_c
+            for delta in log:
+                plan = plan_delta(
+                    hin_cur, delta, self.metapath,
+                    max_delta_fraction=self.config.delta_threshold,
+                )
+                if plan.fallback:
+                    return {"abandoned": "replayed delta fell back"}
+                try:
+                    backend.apply_delta(plan)
+                except DeltaUnsupported:
+                    return {"abandoned": "replayed delta unsupported"}
+                hin_cur = plan.hin_new
+            with get_tracer().child_span(
+                "compact.swap", replayed=len(log)
+            ):
+                self.coalescer.drain()
+                self._swap_compacted(backend, hin_cur)
+            comp._chain = 0
+            return {
+                "replayed_deltas": len(log),
+                "pause_s": time.perf_counter() - t_pause,
+                "capacity": {
+                    t: idx.padded_size
+                    for t, idx in hin_cur.indices.items()
+                    if idx.capacity is not None
+                },
+                "token": list(self.consistency_token),
+            }
+
+    def _swap_compacted(self, backend: PathSimBackend, hin) -> None:
+        """Install a compaction-built backend for the SAME logical
+        graph. Caller (:meth:`_apply_compaction`) holds ``_swap_lock``
+        with the pipeline drained. Unlike :meth:`_install_backend`
+        this preserves the consistency token, the chained fingerprint,
+        the per-row cache versions, and BOTH cache tiers — the graph
+        content did not change, only its physical padding — so router
+        fencing sees nothing and every warm entry stays servable. The
+        bucket ladder is untouched (keyed on the unchanged logical n),
+        and no rewarm runs here: the build thread warmed the new
+        padded shapes before taking the lock."""
+        self.backend = backend
+        self.hin = hin
+        # engines bind the old hin generation; they rebuild lazily
+        with self._engines_lock:
+            self._engines.clear()
+        self.index = self.hin.indices[self.node_type]
+        self.n = self.index.size
+        old_ver = self._row_ver
+        new_ver = np.zeros(self.index.padded_size, dtype=np.int64)
+        m = min(old_ver.shape[0], new_ver.shape[0])
+        new_ver[:m] = old_ver[:m]
+        self._row_ver = new_ver
+        self._d = np.asarray(
+            backend._denominators(self.variant), dtype=np.float64
+        )
+
+    def compact(self, wait_s: float = 300.0) -> dict:
+        """Force one compaction now (the ``compact`` protocol op): the
+        same build-then-hot-swap the automatic triggers run, executed
+        synchronously. Returns the compaction accounting (``swapped``,
+        replayed deltas, build/pause ms, compile count, new per-type
+        capacities). Serving keeps flowing during the build; only the
+        swap itself (drain + replay + install) pauses admissions."""
+        return self._compactor.compact_now(
+            reason="requested", wait_s=wait_s
+        )
+
     def _engine_summaries(self) -> dict:
         with self._engines_lock:
             engines = sorted(self._engines.items())
@@ -1484,6 +1631,11 @@ class PathSimService:
                 "headroom": self.index.headroom,
                 **self._update_stats,
             },
+            # Background compaction accounting (DESIGN.md §30): trigger
+            # state, swap/abandon counters, and the last swap's
+            # build/pause/compile numbers — the firehose bench's gates
+            # read these instead of replaying the event log.
+            "compaction": self._compactor.snapshot(),
             "result_cache": {
                 "hits": self.result_cache.hits,
                 "misses": self.result_cache.misses,

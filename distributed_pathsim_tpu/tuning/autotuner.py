@@ -414,6 +414,101 @@ def bench_factor_format(point: SweepPoint, reps: int, k: int = 10) -> dict:
     return {"factor_format": (winner, res)}
 
 
+def bench_compaction(point: SweepPoint, reps: int, k: int = 10) -> dict:
+    """``compact_chain_len`` + ``compact_headroom`` — real arms: a
+    warm service absorbing a sustained delta stream (edge adds plus
+    periodic node appends, interleaved with affected-row queries)
+    under each trigger setting, end to end. Short chains re-encode
+    often (paying build+swap more), long chains re-encode rarely (but
+    let the headroom trigger — or, past the reserve, the synchronous
+    inline rebuild — do the work); the headroom arms trade re-encode
+    frequency against padded bytes. The numpy backend keeps the race
+    about the knob's own trade — host-side re-encode/replay work vs
+    per-delta bookkeeping — rather than XLA compile noise; compaction
+    itself is bit-invisible (token, fingerprints, caches preserved),
+    so every arm serves identical answers by construction."""
+    from ..backends.base import create_backend
+    from ..data import delta as dl
+    from ..data.synthetic import synthetic_hin
+    from ..ops.metapath import compile_metapath
+    from ..serving import PathSimService, ServeConfig
+
+    n = min(point.n, 2048)
+    n_deltas = 96
+
+    def workload(chain_len: int, headroom: float):
+        hin = dl.with_headroom(
+            synthetic_hin(n, 2 * n, max(point.v // 8, 8), seed=0), 0.25
+        )
+        mp = compile_metapath("APVPA", hin.schema)
+        svc = PathSimService(
+            create_backend("numpy", hin, mp),
+            config=ServeConfig(
+                max_batch=8, max_wait_ms=0.2, warm=False,
+                compact_auto=True, compact_chain_len=chain_len,
+                compact_headroom=headroom, compact_cooldown_s=0.0,
+            ),
+        )
+        rng = np.random.default_rng(0)
+        ap = hin.blocks["author_of"]
+        existing = set(zip(ap.rows.tolist(), ap.cols.tolist()))
+        append_seq = itertools.count()
+
+        def run():
+            for i in range(n_deltas):
+                adds = []
+                while len(adds) < 2:
+                    e = (int(rng.integers(0, n)),
+                         int(rng.integers(0, 2 * n)))
+                    if e not in existing:
+                        existing.add(e)
+                        adds.append(e)
+                nodes = ()
+                if i % 6 == 5:
+                    nodes = (
+                        dl.NodeAppend(node_type="venue", count=1)
+                        if hin.indices["venue"].size_override is not None
+                        else dl.NodeAppend(
+                            node_type="venue",
+                            ids=(f"v_extra_{next(append_seq)}",),
+                        ),
+                    )
+                svc.update(dl.DeltaBatch(
+                    edges=(dl.edge_delta("author_of", add=adds),),
+                    nodes=nodes,
+                ))
+                svc.topk_index(int(adds[0][0]), k=k)
+            # fold any in-flight build into the measurement: the
+            # arm's cost includes the re-encodes it scheduled
+            svc._compactor._done.wait(60.0)
+
+        return svc, run
+
+    out: dict = {}
+    for knob, arms_of in (
+        ("compact_chain_len",
+         lambda c: workload(int(c), 0.25)),
+        ("compact_headroom",
+         lambda c: workload(8, float(c))),
+    ):
+        services, arms = [], {}
+        for cand in KNOBS[knob].candidates({"n": n}):
+            svc, run = arms_of(cand)
+            services.append(svc)
+            arms[f"arm{cand}"] = run
+        res = br.time_interleaved(arms, reps, warmup=0)
+        win = br.best_arm(res)
+        choice = win.removeprefix("arm")
+        out[knob] = (
+            int(choice) if knob == "compact_chain_len"
+            else float(choice),
+            res,
+        )
+        for svc in services:
+            svc.close()
+    return out
+
+
 def bench_ring(point: SweepPoint, reps: int, k: int = 10) -> dict:
     """Ring-step fold choice on a 1-device mesh: the same compiled
     shard_map program a real slice runs per step, minus the ICI hop —
@@ -810,6 +905,8 @@ def tune(
                 record(point, bench_planner(point, reps))
             if "factor_format" in want:
                 record(point, bench_factor_format(point, reps))
+            if want & {"compact_chain_len", "compact_headroom"}:
+                record(point, bench_compaction(point, reps))
         else:
             if "sparse_tile_rows" in want:
                 record(point, bench_sparse_tiles(point, reps),

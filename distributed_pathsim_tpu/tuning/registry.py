@@ -200,6 +200,30 @@ KNOBS: dict[str, Knob] = {
             candidates=lambda ctx: ["coo", "blocked", "bitpacked"],
         ),
         Knob(
+            name="compact_chain_len",
+            doc="background-compaction chain trigger (serving/"
+            "compact.py, DESIGN.md §30): deltas absorbed since the "
+            "last re-encode before a compaction is scheduled. Shorter "
+            "chains bound cache-version drift and keep the replay log "
+            "tiny but pay the off-path rebuild more often; the arms "
+            "race a sustained update+query workload end to end. "
+            "Bit-invisible: compaction re-encodes the SAME logical "
+            "graph (token, fingerprints, and caches preserved), so "
+            "the choice moves only when work happens, never results.",
+            candidates=lambda ctx: [64, 256, 1024],
+        ),
+        Knob(
+            name="compact_headroom",
+            doc="fresh capacity reserve of a compaction re-encode, as "
+            "a fraction of the logical size (padded to pow-2 "
+            "buckets): more headroom buys fewer headroom-triggered "
+            "compactions per appended node at more resident padding. "
+            "Measured on the same sustained firehose workload as "
+            "compact_chain_len; results bit-identical by the padding "
+            "invariant (data/delta.py with_headroom).",
+            candidates=lambda ctx: [0.25, 0.5, 1.0],
+        ),
+        Knob(
             name="serve_buckets",
             doc="serving bucket-ladder geometry pre-compiled at "
             "warmup: 'pow2' (1,2,4,…; <2x pad waste, log2(B)+1 "

@@ -1,0 +1,5 @@
+"""BAD: swaps a compaction-built backend around the doorway (CP001)."""
+
+
+def hot_swap(service, backend, hin):
+    service._swap_compacted(backend, hin)

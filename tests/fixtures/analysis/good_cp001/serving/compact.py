@@ -1,0 +1,5 @@
+"""GOOD: the background builder goes through the service doorway."""
+
+
+def build_and_swap(service, backend, hin_c, token0):
+    return service._apply_compaction(backend, hin_c, token0)

@@ -1,0 +1,11 @@
+"""Fixture: the guarded compaction-doorway surface registry."""
+COMPACTION_SURFACE = frozenset({"_apply_compaction", "_swap_compacted"})
+
+
+class PathSimService:
+    def _apply_compaction(self, backend, hin_c, token0):
+        self._swap_compacted(backend, hin_c)
+        return {"replayed_deltas": 0}
+
+    def _swap_compacted(self, backend, hin):
+        self.backend = backend

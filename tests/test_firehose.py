@@ -1,0 +1,418 @@
+"""Firehose ingestion: background compaction, coalesced updates, autoscale.
+
+The ISSUE-15 surface (DESIGN.md §30), tested at three layers:
+
+- **compaction unit contracts**: the hot-swap preserves the
+  consistency token, the chained fingerprint, and both cache tiers;
+  deltas landing mid-build replay onto the new backend; answers stay
+  bit-identical to an oracle throughout.
+- **coalescing property**: K sequentially valid deltas folded by
+  :func:`~distributed_pathsim_tpu.data.delta.coalesce_deltas` into ONE
+  batch produce the identical graph — bit-exact scores across all
+  four backends, add/remove cancellation included.
+- **chaos**: a worker SIGKILLed mid-compaction loses zero requests,
+  the survivor swaps cleanly, and a freshly spawned replacement
+  catches up by epoch replay to answers bit-identical to an oracle
+  that absorbed the same deltas.
+
+``test_bench_firehose_smoke`` wires ``make firehose-smoke`` into
+tier-1 (short sustained stream + one forced steady-state compaction +
+the coalescing burst + one autoscale step).
+"""
+
+from __future__ import annotations
+
+import pathlib
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from distributed_pathsim_tpu.backends.base import create_backend
+from distributed_pathsim_tpu.data import delta as dl
+from distributed_pathsim_tpu.data.synthetic import synthetic_hin
+from distributed_pathsim_tpu.ops.metapath import compile_metapath
+from distributed_pathsim_tpu.serving import PathSimService, ServeConfig
+
+REPO = str(pathlib.Path(__file__).resolve().parents[1])
+
+
+def _mk_hin(n_authors=128, n_papers=224, n_venues=8, seed=0,
+            headroom=0.25):
+    return dl.with_headroom(
+        synthetic_hin(n_authors, n_papers, n_venues, seed=seed,
+                      materialize_ids=True),
+        headroom,
+    )
+
+
+def _service(hin, mp, **cfg):
+    cfg.setdefault("max_wait_ms", 0.2)
+    cfg.setdefault("warm", False)
+    return PathSimService(
+        create_backend("numpy", hin, mp), config=ServeConfig(**cfg)
+    )
+
+
+def _fresh_edges(hin_or_set, rng, n, n_authors, n_papers):
+    if isinstance(hin_or_set, set):
+        existing = hin_or_set
+    else:
+        ap = hin_or_set.blocks["author_of"]
+        existing = set(zip(ap.rows.tolist(), ap.cols.tolist()))
+    adds = []
+    while len(adds) < n:
+        e = (int(rng.integers(0, n_authors)),
+             int(rng.integers(0, n_papers)))
+        if e not in existing:
+            existing.add(e)
+            adds.append(e)
+    return adds
+
+
+# -- compaction unit contracts ---------------------------------------------
+
+
+def test_compact_preserves_token_fingerprint_and_caches():
+    hin = _mk_hin()
+    mp = compile_metapath("APVPA", hin.schema)
+    svc = _service(hin, mp)
+    try:
+        rng = np.random.default_rng(0)
+        adds = _fresh_edges(svc.hin, rng, 3, 128, 224)
+        info = svc.update(dl.DeltaBatch(
+            edges=(dl.edge_delta("author_of", add=adds),)
+        ))
+        assert info["mode"] == "delta"
+        tok = svc.consistency_token
+        fp = svc._fp
+        v1, i1 = svc.topk_index(5, 5)
+        hits0 = svc.stats()["result_cache"]["hits"]
+        res = svc.compact()
+        assert res["swapped"], res
+        # token, fingerprint, caches: all preserved — compaction is
+        # the one "update" that invalidates nothing
+        assert svc.consistency_token == tok
+        assert svc._fp == fp
+        v2, i2 = svc.topk_index(5, 5)
+        assert np.array_equal(v1, v2) and np.array_equal(i1, i2)
+        assert svc.stats()["result_cache"]["hits"] == hits0 + 1
+        # fresh pow-2 capacity actually reserved
+        cap = res["capacity"]["author"]
+        assert cap >= svc.n and (cap & (cap - 1)) == 0
+    finally:
+        svc.close()
+
+
+def test_compact_replays_mid_build_deltas():
+    """Deltas that land while the build is in flight replay onto the
+    new backend at swap — the post-swap graph is the live graph, and
+    answers stay bit-identical to an oracle that absorbed everything
+    sequentially."""
+    hin = _mk_hin()
+    mp = compile_metapath("APVPA", hin.schema)
+    svc = _service(hin, mp)
+    oracle = _service(_mk_hin(), mp)
+    try:
+        rng = np.random.default_rng(1)
+        # stall the factory so the build window is wide open
+        real_factory = svc._backend_factory
+
+        def slow_factory(h):
+            time.sleep(0.15)
+            return real_factory(h)
+
+        svc._backend_factory = slow_factory
+        svc._compactor.chain_len = 3
+        svc._compactor.cooldown_s = 0.0
+        deltas = []
+        for i in range(6):
+            adds = _fresh_edges(svc.hin, rng, 2, 128, 224)
+            deltas.append(dl.DeltaBatch(
+                edges=(dl.edge_delta("author_of", add=adds),)
+            ))
+            svc.update(deltas[-1])
+            if i == 2:
+                # the chain trigger just fired: yield until the build
+                # thread has its snapshot, so the REMAINING updates
+                # demonstrably land inside the build window
+                deadline = time.monotonic() + 5
+                while (
+                    not svc._compactor.inflight
+                    and time.monotonic() < deadline
+                ):
+                    time.sleep(0.005)
+                time.sleep(0.03)
+        svc._compactor._done.wait(30.0)
+        comp = svc.stats()["compaction"]
+        assert comp["compactions"] >= 1, comp
+        assert (comp["last"].get("replayed_deltas", 0) > 0
+                or comp["compactions"] > 1), comp
+        for d in deltas:
+            oracle.update(d)
+        assert svc.consistency_token == oracle.consistency_token
+        for row in (0, 7, 42, 99):
+            a = svc.topk_index(row, 5)
+            b = oracle.topk_index(row, 5)
+            assert np.array_equal(a[0], b[0])
+            assert np.array_equal(a[1], b[1])
+    finally:
+        svc.close()
+        oracle.close()
+
+
+def test_headroom_trigger_compacts_before_exhaustion():
+    """A sustained append stream with auto compaction on never hits
+    the synchronous headroom-exhausted inline rebuild: the background
+    re-encode refreshes the reserve first."""
+    hin = _mk_hin(headroom=0.10)
+    mp = compile_metapath("APVPA", hin.schema)
+    svc = _service(
+        hin, mp, compact_auto=True, compact_chain_len=10_000,
+        compact_headroom_frac=0.5, compact_cooldown_s=0.0,
+        compact_headroom=1.0,
+    )
+    try:
+        rng = np.random.default_rng(2)
+        for i in range(40):
+            n_auth = svc.hin.type_size("author")
+            adds = [(n_auth, int(rng.integers(0, 224)))]
+            svc.update(dl.DeltaBatch(
+                edges=(dl.edge_delta("author_of", add=adds),),
+                nodes=(dl.NodeAppend(node_type="author",
+                                     ids=(f"fh_a{i}",)),),
+            ))
+            # bounded wait whenever a build is in flight: the stream
+            # outpacing the builder is load, not a correctness issue
+            svc._compactor._done.wait(30.0)
+        st = svc.stats()
+        assert st["delta"]["rebuilds"] == 0, st["delta"]
+        assert st["compaction"]["compactions"] >= 1
+        assert st["delta"]["seq"] == 40
+    finally:
+        svc.close()
+
+
+# -- coalescing property (K coalesced == K sequential, all backends) -------
+
+
+@pytest.mark.parametrize(
+    "backend", ["numpy", "jax", "jax-sparse", "jax-sharded"]
+)
+def test_coalesced_deltas_bit_identical_all_backends(backend):
+    hin0 = synthetic_hin(96, 160, 6, seed=3, materialize_ids=True)
+    hin0 = dl.with_headroom(hin0, 0.25)
+    mp = compile_metapath("APVPA", hin0.schema)
+    rng = np.random.default_rng(3)
+    existing = set(zip(hin0.blocks["author_of"].rows.tolist(),
+                       hin0.blocks["author_of"].cols.tolist()))
+    batches = []
+    # batch 1: plain adds (one lands on an appended author)
+    adds1 = _fresh_edges(existing, rng, 2, 96, 160)
+    batches.append(dl.DeltaBatch(
+        edges=(dl.edge_delta("author_of",
+                             add=adds1 + [(96, 3)]),),
+        nodes=(dl.NodeAppend(node_type="author", ids=("co_a0",)),),
+    ))
+    # batch 2: removes one of batch 1's adds (must cancel), adds more
+    adds2 = _fresh_edges(existing, rng, 2, 96, 160)
+    batches.append(dl.DeltaBatch(
+        edges=(dl.edge_delta("author_of", add=adds2,
+                             remove=[adds1[0]]),),
+    ))
+    # batch 3: re-adds the cancelled edge (net: present again) and
+    # removes a base edge
+    base_edge = next(iter(sorted(existing)))
+    batches.append(dl.DeltaBatch(
+        edges=(dl.edge_delta("author_of", add=[adds1[0]],
+                             remove=[base_edge]),),
+    ))
+    # batch 4: adds touching the appended author again
+    batches.append(dl.DeltaBatch(
+        edges=(dl.edge_delta("author_of", add=[(96, 7)]),),
+    ))
+
+    hin_seq = hin0
+    for b in batches:
+        hin_seq, grew = dl.apply_delta(hin_seq, b)
+        assert not grew
+    merged = dl.coalesce_deltas(batches)
+    assert merged.n_edge_changes < sum(b.n_edge_changes for b in batches)
+    hin_co, grew = dl.apply_delta(hin0, merged)
+    assert not grew
+
+    b_seq = create_backend(backend, hin_seq, mp)
+    b_co = create_backend(backend, hin_co, mp)
+    rows = np.arange(0, hin_seq.type_size("author"), 7)
+    vs, is_ = b_seq.topk_rows(rows, k=5)
+    vc, ic = b_co.topk_rows(rows, k=5)
+    assert np.array_equal(np.asarray(vs), np.asarray(vc))
+    assert np.array_equal(np.asarray(is_), np.asarray(ic))
+    ss = np.asarray(b_seq.scores_rows(rows[:4]))
+    sc = np.asarray(b_co.scores_rows(rows[:4]))
+    assert np.array_equal(ss, sc)
+
+
+def test_coalesce_rejects_window_conflicts():
+    e = (1, 2)
+    add = dl.DeltaBatch(edges=(dl.edge_delta("author_of", add=[e]),))
+    with pytest.raises(dl.NotCoalescable):
+        dl.coalesce_deltas([add, add])
+    rem = dl.DeltaBatch(edges=(dl.edge_delta("author_of", remove=[e]),))
+    with pytest.raises(dl.NotCoalescable):
+        dl.coalesce_deltas([rem, rem])
+    # add → remove → add collapses to a single net add
+    merged = dl.coalesce_deltas([add, rem, add])
+    assert merged.edges[0].add.shape[0] == 1
+    assert merged.edges[0].remove.shape[0] == 0
+
+
+# -- chaos: kill a worker mid-compaction -----------------------------------
+
+
+@pytest.mark.chaos
+def test_kill_worker_mid_compaction():
+    """SIGKILL one of two replicas while BOTH are compacting, under
+    query load: zero lost requests, the survivor swaps cleanly (token
+    unchanged, answers exact), and a freshly spawned replacement
+    catches up by epoch replay to bit-identical answers vs an oracle
+    absorbing the same deltas."""
+    from distributed_pathsim_tpu.router import (
+        InprocTransport, Router, RouterConfig, WorkerRuntime,
+    )
+
+    mp = compile_metapath(
+        "APVPA", synthetic_hin(96, 160, 6, seed=4).schema
+    )
+
+    def make_transport(wid: str):
+        svc = _service(_mk_hin(96, 160, 6, seed=4), mp)
+        # widen the compaction window so the kill lands inside it
+        real_factory = svc._backend_factory
+
+        def slow_factory(h):
+            time.sleep(0.25)
+            return real_factory(h)
+
+        svc._backend_factory = slow_factory
+        return InprocTransport(wid, WorkerRuntime(svc, worker_id=wid))
+
+    transports = {w: make_transport(w) for w in ("w0", "w1")}
+    router = Router(transports, RouterConfig(
+        heartbeat_interval_s=0.05, heartbeat_miss_limit=100,
+        hedge_ms=None, max_inflight=8192, scrape_interval_s=0,
+        retain_replay=True,
+    ))
+    router.start()
+    oracle = _service(_mk_hin(96, 160, 6, seed=4), mp)
+    try:
+        rng = np.random.default_rng(4)
+        deltas = []
+        for _ in range(4):
+            adds = _fresh_edges(oracle.hin, rng, 2, 96, 160)
+            deltas.append([
+                {"rel": "author_of", "src_row": int(r), "dst_row": int(c)}
+                for r, c in adds
+            ])
+            resp = router.request(
+                {"op": "update", "add_edges": deltas[-1]}, timeout=30,
+            )
+            assert resp["ok"], resp
+            oracle.update(dl.delta_from_records(
+                oracle.hin, add_edges=deltas[-1]
+            ))
+        tok_before = oracle.consistency_token
+        # both replicas start compacting (the op blocks each worker's
+        # loop mid-build); queries + the kill land inside the window
+        for wid in ("w0", "w1"):
+            router.workers[wid].transport.send(
+                {"op": "compact", "id": f"force-{wid}"}
+            )
+        futs = [
+            router.submit({"op": "topk", "row": int(r), "k": 5})
+            for r in rng.integers(0, 96, size=24)
+        ]
+        time.sleep(0.05)  # inside w0's slowed build
+        router.workers["w0"].transport.kill()
+        lost = 0
+        for f in futs:
+            resp = f.result(timeout=60)
+            if not resp.get("ok"):
+                lost += 1
+        assert lost == 0
+        # survivor swapped cleanly: compaction ran, token unchanged
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            svc1 = transports["w1"].runtime.service
+            if svc1._compactor.compactions >= 1 and (
+                not svc1._compactor.inflight
+            ):
+                break
+            time.sleep(0.02)
+        assert svc1._compactor.compactions >= 1
+        assert svc1.consistency_token == tok_before
+        # keep the stream going on the survivor
+        adds = _fresh_edges(oracle.hin, rng, 2, 96, 160)
+        recs = [{"rel": "author_of", "src_row": int(r),
+                 "dst_row": int(c)} for r, c in adds]
+        resp = router.request({"op": "update", "add_edges": recs},
+                              timeout=30)
+        assert resp["ok"], resp
+        oracle.update(dl.delta_from_records(oracle.hin, add_edges=recs))
+        # a spawned replacement catches up by epoch replay ...
+        transports["w2"] = make_transport("w2")
+        router.add_worker("w2", transports["w2"])
+        head = None
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            with router._lock:
+                w2 = router.workers["w2"]
+                head = len(router._epochs) - 1
+                if w2.epoch == head:
+                    break
+            time.sleep(0.02)
+        with router._lock:
+            assert router.workers["w2"].epoch == head
+        # ... to answers bit-identical to the oracle
+        for row in (0, 9, 33, 80):
+            resp = router.request({"op": "topk", "row": row, "k": 5},
+                                  timeout=30)
+            assert resp["ok"], resp
+            vals, idxs = oracle.topk_index(row, 5)
+            want = [
+                (oracle._ident(int(j))[0], float(v))
+                for v, j in zip(vals, idxs) if np.isfinite(v)
+            ]
+            got = [(h["id"], h["score"]) for h in resp["result"]["topk"]]
+            assert got == want
+    finally:
+        router.close()
+        oracle.close()
+        for t in transports.values():
+            t.runtime.service.close()
+
+
+# -- CI smoke: the acceptance measurement (make firehose-smoke) ------------
+
+
+def test_bench_firehose_smoke(tmp_path):
+    """``make firehose-smoke`` in-process: short sustained firehose +
+    one forced steady-state compaction + the coalescing burst + one
+    autoscale step — zero lost, zero non-compaction compiles, zero
+    steady-state compaction compiles, bounded update-visible p99,
+    spawn/drain reactions in the decision log (ISSUE 15 acceptance)."""
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    import bench_serving
+
+    result = bench_serving.run_firehose_smoke(
+        str(tmp_path / "firehose.json")
+    )
+    assert all(result["smoke_checks"].values()), result["smoke_checks"]
+    s = result["sustained"]
+    assert s["compiles_outside_compaction"] == 0
+    assert s["compaction"]["count"] >= 1
+    assert result["fleet"]["broadcasts"] < result["fleet"]["updates"]
+    assert result["autoscale"]["spawn_tick"] is not None
+    assert result["autoscale"]["drain_tick"] is not None

@@ -434,6 +434,48 @@ def test_checked_in_cpu_table_exercises_hit_path():
     assert tuning.lookup_stats().get("nearest", 0) >= 1
 
 
+def test_checked_in_table_has_measured_factor_format():
+    """The PR-14 follow-up: the committed CPU table carries MEASURED
+    ``factor_format`` entries per shape bucket (every arm's time AND
+    resident bytes persisted — the deciding evidence stays auditable
+    from the table alone), and a jax-sparse backend built under the
+    table resolves the knob through the table-hit path and honors the
+    chosen layout."""
+    path = REPO / "artifacts" / "tuning_table_cpu.json"
+    assert tuning.install_table(str(path))
+    table = tuning.active_table()
+    ff_entries = {
+        k: e for k, e in table.entries.items()
+        if k.startswith("factor_format|")
+    }
+    # per shape bucket: at least two distinct n-buckets measured
+    assert len(ff_entries) >= 2, sorted(table.entries)
+    for key, ent in ff_entries.items():
+        assert ent.choice in ("coo", "blocked", "bitpacked"), key
+        # every candidate raced, with its resident bytes recorded
+        for fmt in ("coo", "blocked", "bitpacked"):
+            assert fmt in ent.arms, (key, ent.arms)
+            assert f"{fmt}_bytes" in ent.arms, (key, ent.arms)
+    # the serving path consumes the entry: a jax-sparse backend at a
+    # measured bucket resolves through the table (hit or nearest —
+    # never the heuristic default) and holds the chosen layout
+    from distributed_pathsim_tpu.backends.base import create_backend
+    from distributed_pathsim_tpu.data.synthetic import synthetic_hin
+    from distributed_pathsim_tpu.ops.metapath import compile_metapath
+
+    hin = synthetic_hin(2048, 4096, 24, seed=0)
+    mp = compile_metapath("APVPA", hin.schema)
+    before = tuning.lookup_stats()
+    backend = create_backend("jax-sparse", hin, mp)
+    after = tuning.lookup_stats()
+    assert (
+        after.get("hit", 0) + after.get("nearest", 0)
+        > before.get("hit", 0) + before.get("nearest", 0)
+    )
+    want = tuning.choose("factor_format", n=2048, default="coo")
+    assert (backend.factor_info() or {}).get("format") == want
+
+
 def test_tune_smoke():
     """make tune-smoke, wired non-slow: measured table → tuned serving
     with zero steady-state compiles, plus the fallback ladder."""
