@@ -5,7 +5,8 @@ PYTHON ?= python
 
 .PHONY: test chaos chaos-router serve-smoke update-smoke obs-smoke \
 	router-smoke partition-smoke ann-smoke fleet-obs-smoke \
-	metapath-smoke compress-smoke firehose-smoke lint lint-schema \
+	metapath-smoke compress-smoke firehose-smoke batch-smoke \
+	lint lint-schema \
 	lint-telemetry tune-smoke lint-tuning tune
 
 # Tier-1: the fast CPU suite (the driver's acceptance gate).
@@ -131,6 +132,19 @@ fleet-obs-smoke:
 # covers it.
 firehose-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) bench_serving.py --regime firehose --smoke
+
+# Batch campaign smoke: the corpus-sweep tier on a small fixed-seed
+# graph — top-k-for-every-row (decode-overlapped blocked GEMM) and the
+# certificate-pruned threshold simjoin, single-host AND 2-worker
+# batch_blocks fleet arms. Hard gates: sampled-row top-k bit-identical
+# to the serving oracle, preempt → resume byte-identical shard files
+# with completed blocks skipped, zero pairs >= tau dropped by pruning
+# (brute-force cross-check), zero steady-state recompiles, fleet
+# answers bit-identical to single-host. The same run is wired as a
+# non-slow pytest (tests/test_batch.py::test_bench_batch_smoke), so
+# tier-1 covers it.
+batch-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) bench_serving.py --regime batch --smoke
 
 # Metapath planner smoke: the DP chain planner beats the naive
 # left-to-right fold on a measured asymmetric chain (estimated AND

@@ -1285,6 +1285,11 @@ def run_fleet_obs_smoke(out_path: str | None = None) -> dict:
     try:
         router.start()
         _run_router_clients(router, uniform[:4, :8].tolist(), 5)  # warm
+        # pin a post-warm scrape of BOTH workers before the killer can
+        # take w0: the merge-crosses-workers gate needs w0 to have a
+        # snapshot at all, and on a warm box the kill (50 ms into main
+        # load) legitimately outruns the first 0.4 s scrape tick
+        router.fleet_metrics(refresh=True)
         h0 = _router_worker_compiles(router)
         started = threading.Event()
 
@@ -1392,7 +1397,10 @@ def run_fleet_obs_smoke(out_path: str | None = None) -> dict:
         with open(out_path, "w", encoding="utf-8") as f:
             json.dump(result, f, indent=2)
     if not all(checks.values()):
-        raise AssertionError(f"fleet-obs smoke failed: {checks}")
+        raise AssertionError(
+            f"fleet-obs smoke failed: {checks} "
+            f"(merged={merged_count}, per_worker={worker_counts})"
+        )
     return result
 
 
@@ -3439,6 +3447,247 @@ def run_compress_smoke(out_path: str | None = None) -> dict:
     return result
 
 
+# ---------------------------------------------------------------------------
+# Batch campaign tier (--regime batch): corpus-scale top-k-all sweep +
+# threshold similarity join, single-host and fleet arms (ISSUE 17, §31)
+# ---------------------------------------------------------------------------
+
+# $-per-sweep extrapolation assumption: one on-demand cloud accelerator
+# host (the TPU v4-8 on-demand list price neighborhood). The artifact
+# records the assumption next to the number so the extrapolation can be
+# re-based; the measured quantity is rows/sec on THIS hardware.
+BATCH_USD_PER_HOST_HOUR = 3.22
+BATCH_CORPUS_ROWS = 4_190_000  # the paper's author-corpus sweep size
+
+
+def _batch_fleet(hin, metapath, workers: int = 2):
+    """Inproc 2-replica fleet for the batch_blocks fan-out arm."""
+    from distributed_pathsim_tpu.backends.base import create_backend
+    from distributed_pathsim_tpu.router import (
+        InprocTransport, WorkerRuntime,
+    )
+    from distributed_pathsim_tpu.router.batch import BlockScheduler
+    from distributed_pathsim_tpu.serving import (
+        PathSimService, ServeConfig,
+    )
+
+    services = [
+        PathSimService(
+            create_backend("numpy", hin, metapath),
+            config=ServeConfig(warm=False, max_wait_ms=0.5),
+        )
+        for _ in range(workers)
+    ]
+    transports = {
+        f"w{i}": InprocTransport(
+            f"w{i}", WorkerRuntime(svc, worker_id=f"w{i}")
+        )
+        for i, svc in enumerate(services)
+    }
+    sched = BlockScheduler(transports, straggler_after_s=10.0)
+    sched.start()
+    return services, sched
+
+
+def run_batch_bench(
+    n_authors: int = 2048,
+    n_papers: int = 4096,
+    n_venues: int = 48,
+    k: int = 10,
+    tau: float = 0.05,
+    block_rows: int = 256,
+    sample_rows: int = 64,
+    workers: int = 2,
+    seed: int = 0,
+    out_path: str | None = None,
+) -> dict:
+    """``--regime batch``: the corpus-sweep campaign tier measured end
+    to end on one synthetic graph. Arms: (1) single-host top-k-all
+    (decode-overlapped blocked GEMM) with the sampled-row oracle parity
+    gate and the steady-state compile ledger, (2) a SIGTERM-shaped
+    resume (preemption requested mid-campaign, shard files compared
+    byte-for-byte against an uninterrupted run), (3) threshold simjoin
+    with certificate prune accounting and a brute-force soundness
+    check, (4) the 2-worker ``batch_blocks`` fleet fan-out, bit-parity
+    vs arm 1. Reports rows/sec, bytes read per row, prune ratio, and
+    the $-per-full-corpus-sweep extrapolation."""
+    import hashlib
+    import pathlib
+    import tempfile
+
+    # the batch engine's jax arm requires x64 (f64 must survive the
+    # device); flip it on before anything traces, as tests do
+    try:
+        import jax
+
+        jax.config.update("jax_enable_x64", True)
+    except Exception:
+        pass
+
+    from distributed_pathsim_tpu.backends.base import create_backend
+    from distributed_pathsim_tpu.batch import (
+        BatchEngine, run_simjoin_campaign, run_topk_campaign,
+    )
+    from distributed_pathsim_tpu.data.synthetic import synthetic_hin
+    from distributed_pathsim_tpu.ops.metapath import compile_metapath
+    from distributed_pathsim_tpu.resilience import (
+        Preempted, preemption_handler,
+    )
+
+    rng = np.random.default_rng(seed)
+    hin = synthetic_hin(n_authors, n_papers, n_venues, seed=seed)
+    metapath = compile_metapath("APVPA", hin.schema)
+    engine = BatchEngine(hin, metapath, block_rows=block_rows)
+    checks: dict[str, bool] = {}
+    out: dict = {
+        "bench": "batch",
+        "graph": {
+            "authors": n_authors, "papers": n_papers,
+            "venues": n_venues, "seed": seed,
+        },
+        "k": k, "tau": tau,
+        "block_rows": engine.block_rows,
+        "factor_format": engine.factor_format,
+        "backend_mode": engine.backend_mode,
+    }
+
+    # -- arm 1: single-host top-k-all + parity + compile ledger ----------
+    warm = run_topk_campaign(engine, k)  # first pass compiles the GEMM
+    c0 = _compile_count()
+    res = run_topk_campaign(engine, k)
+    steady_compiles = _compile_count() - c0
+    sample = np.sort(rng.choice(engine.n, size=min(sample_rows, engine.n),
+                                replace=False))
+    oracle = create_backend("numpy", hin, metapath)
+    vals, idxs = oracle.topk_rows(sample, k, variant="rowsum")
+    checks["sampled_rows_bit_identical_to_oracle"] = bool(
+        np.array_equal(res.vals[sample], vals)
+        and np.array_equal(res.idxs[sample], idxs)
+    )
+    checks["zero_steady_state_recompiles"] = steady_compiles == 0
+    out["topk_single_host"] = {
+        "rows_per_s": round(res.rows_per_s, 2),
+        "bytes_read_per_row": round(res.bytes_read_per_row, 2),
+        "elapsed_s": round(res.elapsed_s, 4),
+        "blocks": res.blocks_total,
+        "steady_state_compiles": steady_compiles,
+        "warmup_rows_per_s": round(warm.rows_per_s, 2),
+        "usd_per_corpus_sweep": round(
+            BATCH_CORPUS_ROWS / max(res.rows_per_s, 1e-9) / 3600.0
+            * BATCH_USD_PER_HOST_HOUR, 4,
+        ),
+        "usd_assumption": {
+            "usd_per_host_hour": BATCH_USD_PER_HOST_HOUR,
+            "corpus_rows": BATCH_CORPUS_ROWS,
+        },
+    }
+
+    # -- arm 2: preempt → resume, shard files byte-identical -------------
+    def _hashes(d):
+        return {
+            p.name: hashlib.sha256(p.read_bytes()).hexdigest()
+            for p in pathlib.Path(d).glob("*.npy")
+        }
+
+    with tempfile.TemporaryDirectory() as td:
+        ck_ref = os.path.join(td, "ref")
+        ck_cut = os.path.join(td, "cut")
+        ref = run_topk_campaign(engine, k, checkpoint_dir=ck_ref)
+        cut_at = max(res.blocks_total // 2, 1)
+
+        def _cut(done, total):
+            if done == cut_at:
+                preemption_handler.request("bench")
+
+        resumable = False
+        try:
+            run_topk_campaign(engine, k, checkpoint_dir=ck_cut,
+                              on_block=_cut)
+        except Preempted as e:
+            resumable = e.resumable
+        finally:
+            preemption_handler.reset()
+        resumed = run_topk_campaign(engine, k, checkpoint_dir=ck_cut)
+        checks["resume_skips_completed_blocks"] = (
+            resumable and resumed.blocks_resumed == cut_at
+        )
+        checks["resume_shards_byte_identical"] = (
+            _hashes(ck_cut) == _hashes(ck_ref)
+            and np.array_equal(resumed.vals, ref.vals)
+            and np.array_equal(resumed.idxs, ref.idxs)
+        )
+        out["resume"] = {
+            "blocks_resumed": resumed.blocks_resumed,
+            "blocks_total": resumed.blocks_total,
+        }
+
+    # -- arm 3: simjoin prune soundness + accounting ---------------------
+    sj = run_simjoin_campaign(engine, tau, grouping="degree")
+    scores = oracle.scores_rows(
+        np.arange(engine.n), variant="rowsum"
+    )
+    iu = np.arange(engine.n)
+    ii, jj = np.nonzero((scores >= tau) & (iu[:, None] < iu[None, :]))
+    want = set(zip(ii.tolist(), jj.tolist()))
+    got = set(zip(sj.rows.tolist(), sj.cols.tolist()))
+    checks["zero_pairs_dropped_by_pruning"] = got == want
+    out["simjoin"] = {
+        "pairs": int(sj.rows.shape[0]),
+        "prune_ratio": round(sj.prune_ratio, 4),
+        "block_pairs_pruned": sj.block_pairs_pruned,
+        "block_pairs_total": sj.block_pairs_total,
+        "rows_per_s": round(sj.rows_per_s, 2),
+        "elapsed_s": round(sj.elapsed_s, 4),
+    }
+
+    # -- arm 4: 2-worker fleet fan-out, bit-parity vs single host --------
+    services, sched = _batch_fleet(hin, metapath, workers=workers)
+    try:
+        fres = run_topk_campaign(engine, k, scheduler=sched)
+    finally:
+        sched.close()
+        for svc in services:
+            svc.close()
+    checks["fleet_bit_identical_to_single_host"] = bool(
+        np.array_equal(fres.vals, res.vals)
+        and np.array_equal(fres.idxs, res.idxs)
+    )
+    out["topk_fleet"] = {
+        "workers": workers,
+        "rows_per_s": round(fres.rows_per_s, 2),
+        "elapsed_s": round(fres.elapsed_s, 4),
+    }
+
+    out["checks"] = checks
+    if out_path:
+        with open(out_path, "w", encoding="utf-8") as f:
+            json.dump(out, f, indent=2)
+    return out
+
+
+def run_batch_smoke(out_path: str | None = None) -> dict:
+    """The tier-1 batch-campaign gate (``make batch-smoke`` /
+    ``tests/test_batch.py::test_bench_batch_smoke``). Hard gates:
+    sampled-row top-k bit-identical to the serving oracle, preempt →
+    resume byte-identical shard files, zero pairs ≥ τ dropped by the
+    simjoin certificates, zero steady-state recompiles, and fleet
+    bit-parity — on a small fixed-seed corpus, both arms recorded."""
+    result = run_batch_bench(
+        n_authors=192, n_papers=384, n_venues=12,
+        k=5, tau=0.1, block_rows=32, sample_rows=48,
+        workers=2, seed=7, out_path=None,
+    )
+    result["smoke_checks"] = result.pop("checks")
+    if out_path:
+        with open(out_path, "w", encoding="utf-8") as f:
+            json.dump(result, f, indent=2)
+    if not all(result["smoke_checks"].values()):
+        raise AssertionError(
+            f"batch smoke failed: {result['smoke_checks']}"
+        )
+    return result
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--smoke", action="store_true",
@@ -3446,7 +3695,7 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--regime", default="load",
                    choices=("load", "update", "obs", "router", "ann",
                             "fleet-obs", "partition", "metapath",
-                            "compress", "firehose"),
+                            "compress", "firehose", "batch"),
                    help="'load': the closed-loop QPS regimes; 'update': "
                    "delta-ingestion vs reload latency; 'obs': "
                    "observability overhead (obs on vs off, steady "
@@ -3460,7 +3709,10 @@ def main(argv: list[str] | None = None) -> int:
                    "(BENCH_FLEET_OBS artifact); 'firehose': sustained "
                    "update stream x serving load with background "
                    "compaction, coalesced fleet updates, and the "
-                   "autoscale load step (BENCH_FIREHOSE artifact)")
+                   "autoscale load step (BENCH_FIREHOSE artifact); "
+                   "'batch': corpus-sweep campaigns — top-k-all + "
+                   "threshold simjoin, single-host and fleet arms, "
+                   "resume + parity gates (BENCH_BATCH artifact)")
     p.add_argument("--deltas", type=int, default=10_000,
                    help="firehose regime: sustained updates in phase 1")
     p.add_argument("--replicas", default="1,2,4",
@@ -3484,7 +3736,16 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--out", default=None, help="write the JSON here")
     args = p.parse_args(argv)
 
-    if args.regime == "firehose":
+    if args.regime == "batch":
+        if args.smoke:
+            result = run_batch_smoke(args.out)
+        else:
+            result = run_batch_bench(
+                n_authors=args.authors, n_papers=args.papers,
+                n_venues=args.venues, k=args.k, seed=args.seed,
+                out_path=args.out,
+            )
+    elif args.regime == "firehose":
         if args.smoke:
             result = run_firehose_smoke(args.out)
         else:

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import dataclasses
 
+from .batch_rules import BatchDoorwayPass
 from .compaction_rules import CompactionDoorwayPass
 from .compress_rules import CompressedLayoutPass
 from .determinism import DeterminismPass
@@ -52,6 +53,7 @@ PASS_FAMILIES: dict[str, str] = {
     "CompressedLayoutPass": "compressed factor layouts, "
                             "interprocedural (CF)",
     "CompactionDoorwayPass": "compaction swap doorway (CP)",
+    "BatchDoorwayPass": "batch block-sweep doorway (BT)",
 }
 
 ALL_PASSES = (
@@ -68,6 +70,7 @@ ALL_PASSES = (
     MetapathIRPass(),
     CompressedLayoutPass(),
     CompactionDoorwayPass(),
+    BatchDoorwayPass(),
 )
 
 RULES: dict[str, RuleDoc] = {}

@@ -38,7 +38,7 @@ RULE_DOCS = {
 
 _STDERR_ALLOWED = frozenset({
     "utils/logging.py", "cli.py", "serving/cli.py", "neural_cli.py",
-    "router/cli.py", "index/cli.py", "analysis/cli.py",
+    "router/cli.py", "index/cli.py", "analysis/cli.py", "batch/cli.py",
 })
 _SINK_ALLOWED = frozenset({"utils/logging.py"})
 

@@ -1,0 +1,19 @@
+"""Corpus-scale batch tier: campaigns over every row at once.
+
+Everything landed so far — the DP metapath planner, the packed factor
+formats, the centroid index, partitioned serving — was built to answer
+one request at a time. This package points the same primitives at the
+*whole corpus*: ``topk-all`` (top-k for every source row, a sharded
+blocked GEMM sweep) and ``simjoin`` (every pair scoring ≥ τ, with
+provably score-safe block pruning). Campaigns checkpoint per row block
+through :class:`~..utils.checkpoint.CheckpointManager` and resume
+bit-identically after preemption (DESIGN.md §31).
+"""
+
+from .campaign import (  # noqa: F401
+    BatchEngine,
+    CampaignResult,
+    CampaignSpec,
+    run_topk_campaign,
+)
+from .simjoin import run_simjoin_campaign  # noqa: F401

@@ -226,6 +226,19 @@ def main(argv: list[str] | None = None) -> int:
             msg = exc.args[0] if exc.args else exc
             print(f"error: {msg}", file=sys.stderr)
             return 1
+    if argv and argv[0] == "batch":
+        # ``dpathsim batch topk-all/simjoin/resume`` — corpus-scale
+        # campaigns with per-block checkpointed resume (batch/cli.py).
+        # Preemption is handled inside batch_main (exit 75 + resume
+        # hint), so only user-actionable errors are caught here.
+        from .batch.cli import batch_main
+
+        try:
+            return batch_main(argv[1:])
+        except (KeyError, ValueError, FileNotFoundError) as exc:
+            msg = exc.args[0] if exc.args else exc
+            print(f"error: {msg}", file=sys.stderr)
+            return 1
     if argv and argv[0] == "index":
         # ``dpathsim index build/probe`` — MIPS candidate-generation
         # index artifacts for `serve --topk-mode ann` (index/cli.py).

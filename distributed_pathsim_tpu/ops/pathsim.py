@@ -24,6 +24,21 @@ import numpy as np
 VARIANTS = ("rowsum", "diagonal")
 
 
+def jax_exact():
+    """The jax module iff device arithmetic stays bit-identical to the
+    numpy f64 path: without x64 mode an f64 operand silently downcasts
+    to f32 at device_put (ops/chain.effective_device_dtype), which
+    breaks the exact-integer-counts contract every parity gate rests
+    on — so no x64, no jax. Callers treat None as "score on host"."""
+    try:
+        import jax
+    except Exception:  # pragma: no cover - jax is baked into the image
+        return None
+    if not jax.config.jax_enable_x64:
+        return None
+    return jax
+
+
 def _denominators(m, rowsums, variant: str, xp: Any):
     if variant == "rowsum":
         if rowsums is None:

@@ -188,6 +188,20 @@ class PartitionService:
             "dpathsim_partition_partial_seconds",
             "partition-local partial op wall time by op",
         )
+        self._m_score_backend = reg.counter(
+            "dpathsim_partition_score_backend_total",
+            "partial-op scorings by execution backend (numpy = counted "
+            "fallback: no jax or no x64 mode)",
+        )
+        # jax-backed partial scoring (ROADMAP item 2 debt): the window
+        # matvec + candidate normalize run on device when f64 survives
+        # there (x64 mode), else the numpy arm — both produce identical
+        # bytes because counts are exact integers in f64 and
+        # score_candidates is elementwise. Resolved once: the answer
+        # cannot change mid-process and the hot path shouldn't re-probe.
+        self._jax = pathsim.jax_exact()
+        self._win_dev = {}      # (lo, hi) → device mirror of the window
+        self._win_seq = None    # update_seq the mirrors were cut at
         reg.gauge(
             "dpathsim_partition_rows_held",
             "factor rows resident on this partition worker",
@@ -460,6 +474,33 @@ class PartitionService:
         c_s[cols] = vals
         return c_s, float(req.get("d_source") or 0.0)
 
+    def _window_counts(
+        self, lo_slot: int, hi_slot: int, c_s: np.ndarray
+    ) -> np.ndarray:
+        """``C_held[lo:hi] @ c_s`` — exact integer-valued f64 counts on
+        the fastest exact arm. The jax arm mirrors the held window to
+        the device once per update_seq (a delta invalidates every
+        mirror) and is bit-identical to the numpy arm because the
+        products and sums are exact integers in f64 under any
+        association order; without x64 the mirror would downcast to
+        f32, so that configuration takes the counted numpy fallback."""
+        if self._jax is None:
+            self._m_score_backend.inc(backend="numpy")
+            return self.fs.window_dense(lo_slot, hi_slot) @ c_s
+        if self._win_seq != self.update_seq:
+            self._win_dev.clear()
+            self._win_seq = self.update_seq
+        dev = self._win_dev.get((lo_slot, hi_slot))
+        if dev is None:
+            dev = self._jax.device_put(
+                self.fs.window_dense(lo_slot, hi_slot)
+            )
+            self._win_dev[(lo_slot, hi_slot)] = dev
+        self._m_score_backend.inc(backend="jax")
+        return np.asarray(
+            self._jax.numpy.matmul(dev, self._jax.device_put(c_s))
+        )
+
     def partial_topk(self, req: dict) -> dict:
         """This partition's top-k candidates for range ``g``: exact
         integer pairwise counts against the source tile, f64 scores via
@@ -475,9 +516,9 @@ class PartitionService:
         if hi_slot == lo_slot:
             return {"range": g, "cands": [], "seq": self.update_seq}
         c_s, d_source = self._source_tile(req)
-        c_win = self.fs.window_dense(lo_slot, hi_slot)
         d_win = self._d_held[lo_slot:hi_slot]
-        m = c_win @ c_s  # exact: integer-valued f64 products
+        # exact integer-valued f64 products, jax-backed when x64 holds
+        m = self._window_counts(lo_slot, hi_slot, c_s)
         scores = pathsim.score_candidates(
             m[None, :], np.asarray([d_source]), d_win[None, :], xp=np
         )
@@ -514,7 +555,7 @@ class PartitionService:
         g = int(req.get("range") or 0)
         lo_slot, hi_slot, glo, ghi = self._window(g)
         c_s, _ = self._source_tile(req)
-        m = self.fs.window_dense(lo_slot, hi_slot) @ c_s
+        m = self._window_counts(lo_slot, hi_slot, c_s)
         d_win = self._d_held[lo_slot:hi_slot]
         self._m_partial.observe(
             time.perf_counter() - t0, op="partial_scores"

@@ -130,6 +130,10 @@ PROTOCOL_OPS = frozenset({
     # runtime, so routed-delta retries dedup by request_id.
     "resolve", "part_info", "set_colsum", "tile_pull", "partial_topk",
     "partial_scores", "part_update",
+    # batch-campaign block op (DESIGN.md §31): the router-side block
+    # scheduler fans topk-all / simjoin row blocks across replicas;
+    # idempotent and read-only, so straggler re-dispatch needs no dedup
+    "batch_blocks",
 })
 
 # op → (latency-histogram cell, error-counter cell), bound on first use
@@ -278,6 +282,17 @@ def _dispatch_op(
                 row, metapath=req.get("metapath")
             ).tolist(),
         }
+    if op == "batch_blocks":
+        # one batch-campaign row block (router/batch.py scheduler):
+        # answered through the same backend calls the oracle parity
+        # tests pin, fenced on the campaign's (base_fp, delta_seq)
+        handler = getattr(service, "batch_blocks", None)
+        if handler is None:
+            raise KeyError(
+                "op 'batch_blocks' requires a replica service "
+                "(partition workers serve partial_* ops only)"
+            )
+        return handler(req)
     if op == "resolve":
         # label/id → global dense row; any worker answers (partition
         # workers keep FULL index spaces — only edges are sliced)

@@ -200,6 +200,20 @@ KNOBS: dict[str, Knob] = {
             candidates=lambda ctx: ["coo", "blocked", "bitpacked"],
         ),
         Knob(
+            name="batch_block_rows",
+            doc="batch-campaign sweep block height (batch/campaign.py, "
+            "DESIGN.md §31): rows decoded + GEMM'd per block of a "
+            "topk-all / simjoin sweep. Taller blocks amortize the "
+            "resident Cᵀ operand over more rows but coarsen the "
+            "checkpoint/preemption granularity and the simjoin prune "
+            "intervals. Snapped to the pow-2 ladder so every block of "
+            "a campaign shares ONE compiled program shape "
+            "(zero steady-state recompiles). Bit-invisible: counts "
+            "are exact integers in f64, so block height can never "
+            "move a score.",
+            candidates=lambda ctx: [128, 256, 512, 1024],
+        ),
+        Knob(
             name="compact_chain_len",
             doc="background-compaction chain trigger (serving/"
             "compact.py, DESIGN.md §30): deltas absorbed since the "

@@ -1,0 +1,19 @@
+"""Fixture: the guarded block-sweep surface registry."""
+BATCH_SURFACE = frozenset({
+    "sweep_topk_block", "sweep_scores_block", "sweep_pair_block",
+})
+
+
+class BatchEngine:
+    def sweep_topk_block(self, lo, hi, k):
+        return [], []
+
+    def sweep_scores_block(self, lo, hi):
+        return [], []
+
+    def sweep_pair_block(self, rows_i, cols_j):
+        return []
+
+
+def run_topk_campaign(engine, k):
+    return engine.sweep_topk_block(0, 1, k)

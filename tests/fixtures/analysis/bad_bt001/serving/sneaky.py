@@ -1,0 +1,5 @@
+"""BAD: sweeps a block around the campaign doorway (BT001)."""
+
+
+def answer_all(engine, k):
+    return engine.sweep_topk_block(0, 256, k)

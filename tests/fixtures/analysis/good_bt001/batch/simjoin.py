@@ -1,0 +1,5 @@
+"""GOOD: the simjoin runner is a sanctioned sweep caller."""
+
+
+def run_simjoin_campaign(engine, tau):
+    return engine.sweep_pair_block([0], [1])

@@ -1,0 +1,468 @@
+"""Corpus-scale batch tier: campaigns, pruned joins, resume, fleet.
+
+The load-bearing guarantees (ISSUE 17 / DESIGN.md §31):
+
+- a topk-all campaign's per-row answers are BIT-identical to the
+  serving oracle (``backend.topk_rows``) on every backend — same exact
+  integer counts, same f64 normalization, same tie order;
+- a campaign preempted mid-sweep (real SIGTERM) resumes from its
+  checkpoint directory, skips completed blocks, and re-produces
+  byte-identical shard files and final arrays;
+- the simjoin block pruning NEVER drops a qualifying pair: every
+  certificate (degree bound, zero numerator, disjoint supports) only
+  over-estimates scores — property-tested over random graphs × random
+  τ × every grouping;
+- a checkpoint directory from a different campaign (graph delta landed,
+  different k/τ/metapath) is refused loudly, never silently mixed;
+- the ``batch_blocks`` wire op serves the same bytes through the
+  protocol, fenced on (base_fp, delta_seq) AND metapath, and the block
+  scheduler's fleet fan-out (straggler re-dispatch, death requeue)
+  changes nothing but wall-clock.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pathlib
+import signal
+
+import numpy as np
+import pytest
+
+from distributed_pathsim_tpu.backends.base import create_backend
+from distributed_pathsim_tpu.batch import (
+    BatchEngine,
+    run_simjoin_campaign,
+    run_topk_campaign,
+)
+from distributed_pathsim_tpu.data.synthetic import synthetic_hin
+from distributed_pathsim_tpu.ops.metapath import compile_metapath
+from distributed_pathsim_tpu.resilience import (
+    Preempted,
+    preemption_handler,
+)
+from distributed_pathsim_tpu.router import InprocTransport, WorkerRuntime
+from distributed_pathsim_tpu.router.batch import (
+    BatchFleetError,
+    BlockScheduler,
+)
+from distributed_pathsim_tpu.serving import PathSimService, ServeConfig
+from distributed_pathsim_tpu.serving.protocol import handle_request
+
+BACKENDS = ["numpy", "jax", "jax-sparse", "jax-sharded"]
+
+
+@pytest.fixture(scope="module")
+def hin():
+    return synthetic_hin(130, 240, 8, seed=5)
+
+
+@pytest.fixture(scope="module")
+def metapath(hin):
+    return compile_metapath("APVPA", hin.schema)
+
+
+@pytest.fixture(scope="module")
+def engine(hin, metapath):
+    return BatchEngine(hin, metapath, block_rows=32)
+
+
+@pytest.fixture
+def preemption():
+    yield preemption_handler
+    preemption_handler.uninstall()
+    preemption_handler.reset()
+
+
+def _shard_hashes(ckdir) -> dict:
+    return {
+        p.name: hashlib.sha256(p.read_bytes()).hexdigest()
+        for p in pathlib.Path(ckdir).glob("*.npy")
+    }
+
+
+# -- oracle parity (the hard acceptance gate) ------------------------------
+
+
+@pytest.mark.parametrize("backend_name", BACKENDS)
+def test_topk_campaign_matches_serving_oracle(hin, metapath, engine,
+                                              backend_name):
+    """Sampled campaign rows vs the oracle's topk_rows: bit-identical
+    values AND indices (tie order included) on every backend."""
+    res = run_topk_campaign(engine, 7)
+    b = create_backend(backend_name, hin, metapath)
+    sample = np.array([0, 1, 31, 32, 63, 64, 100, engine.n - 1])
+    vals, idxs = b.topk_rows(sample, 7, variant="rowsum")
+    assert np.array_equal(res.vals[sample], vals), backend_name
+    assert np.array_equal(res.idxs[sample], idxs), backend_name
+
+
+def test_campaign_jax_and_numpy_arms_bit_identical(hin, metapath):
+    """The decode-overlapped jax GEMM arm and the pure-numpy arm are
+    the same bytes — the exact-integer-counts contract."""
+    a = run_topk_campaign(BatchEngine(hin, metapath, block_rows=32), 9)
+    nb = BatchEngine(hin, metapath, block_rows=32, use_jax=False)
+    assert nb.backend_mode == "numpy"
+    b = run_topk_campaign(nb, 9)
+    assert np.array_equal(a.vals, b.vals)
+    assert np.array_equal(a.idxs, b.idxs)
+
+
+def test_block_rows_never_move_results(hin, metapath, engine):
+    """Block height is a pure perf knob: any block_rows → identical
+    bytes (padding is sliced off, counts are exact integers)."""
+    ref = run_topk_campaign(engine, 5)
+    for br in (8, 128):
+        res = run_topk_campaign(
+            BatchEngine(hin, metapath, block_rows=br), 5
+        )
+        assert np.array_equal(res.vals, ref.vals), br
+        assert np.array_equal(res.idxs, ref.idxs), br
+
+
+def test_emit_pairs_roundtrips_scores_exactly(engine, tmp_path):
+    """The --emit-pairs training export: JSON f64 round-trip gives the
+    campaign's bytes back (the learned-index distillation contract)."""
+    out = tmp_path / "pairs.jsonl"
+    res = run_topk_campaign(engine, 3, emit_pairs=str(out))
+    recs = [json.loads(line) for line in out.read_text().splitlines()]
+    assert recs, "export is empty"
+    for rec in recs[:: max(len(recs) // 50, 1)]:
+        row = rec["row"]
+        hit = np.flatnonzero(res.idxs[row] == rec["col"])
+        assert hit.size == 1
+        assert res.vals[row][hit[0]] == rec["score"]  # bitwise
+
+
+# -- SIGTERM → resume ------------------------------------------------------
+
+
+def test_sigterm_resume_skips_blocks_byte_identically(
+    hin, metapath, tmp_path, preemption
+):
+    """A real SIGTERM mid-campaign: the in-flight block's shard is
+    already durable, resume skips completed blocks, and both the shard
+    files and the assembled arrays are byte-identical to an
+    uninterrupted run."""
+    eng = BatchEngine(hin, metapath, block_rows=32)
+    ck_ref, ck_cut = tmp_path / "ref", tmp_path / "cut"
+    ref = run_topk_campaign(eng, 7, checkpoint_dir=str(ck_ref))
+    assert preemption.install()
+
+    def on_block(done, total):
+        if done == 2:
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    with pytest.raises(Preempted) as exc_info:
+        run_topk_campaign(
+            eng, 7, checkpoint_dir=str(ck_cut), on_block=on_block
+        )
+    assert exc_info.value.resumable
+    preemption.reset()
+    done_before = set(_shard_hashes(ck_cut))
+    assert done_before, "no shard survived the preemption"
+    res = run_topk_campaign(
+        BatchEngine(hin, metapath, block_rows=32), 7,
+        checkpoint_dir=str(ck_cut),
+    )
+    assert res.blocks_resumed == 2
+    assert np.array_equal(res.vals, ref.vals)
+    assert np.array_equal(res.idxs, ref.idxs)
+    assert _shard_hashes(ck_cut) == _shard_hashes(ck_ref)
+
+
+def test_stale_manifest_refused_loudly(hin, metapath, tmp_path):
+    """A delta landed mid-campaign (different base_fp/delta_seq) — or
+    any identity drift (k, metapath) — must refuse the directory, not
+    silently mix graph versions."""
+    ck = str(tmp_path / "ck")
+    run_topk_campaign(BatchEngine(hin, metapath, block_rows=32), 5,
+                      checkpoint_dir=ck)
+    # different k: same graph, different campaign identity
+    with pytest.raises(ValueError, match="different run"):
+        run_topk_campaign(BatchEngine(hin, metapath, block_rows=32), 6,
+                          checkpoint_dir=ck)
+    # different graph: the delta-landed-mid-campaign case
+    hin2 = synthetic_hin(130, 240, 8, seed=6)
+    eng2 = BatchEngine(
+        hin2, compile_metapath("APVPA", hin2.schema), block_rows=32
+    )
+    with pytest.raises(ValueError, match="different run"):
+        run_topk_campaign(eng2, 5, checkpoint_dir=ck)
+
+
+# -- simjoin: prune soundness ----------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("grouping", ["natural", "degree", "centroid"])
+def test_simjoin_prune_never_drops_a_pair(seed, grouping):
+    """The property the certificates must uphold: for random graphs ×
+    random τ, the pruned join emits EXACTLY the brute-force pair set,
+    scores bitwise equal."""
+    rng = np.random.default_rng(seed)
+    hin = synthetic_hin(
+        int(rng.integers(40, 90)), int(rng.integers(80, 160)),
+        int(rng.integers(3, 9)), seed=seed + 100,
+    )
+    mp = compile_metapath("APVPA", hin.schema)
+    eng = BatchEngine(hin, mp, block_rows=16)
+    scores = create_backend("numpy", hin, mp).scores_rows(
+        np.arange(eng.n), variant="rowsum"
+    )
+    iu = np.arange(eng.n)
+    for tau in (0.02, float(rng.uniform(0.03, 0.4)), 0.9):
+        res = run_simjoin_campaign(eng, tau, grouping=grouping)
+        want_mask = (scores >= tau) & (iu[:, None] < iu[None, :])
+        ii, jj = np.nonzero(want_mask)
+        want = set(zip(ii.tolist(), jj.tolist()))
+        got = set(zip(res.rows.tolist(), res.cols.tolist()))
+        assert got == want, (seed, grouping, tau, want - got, got - want)
+        got_scores = {
+            (r, c): s
+            for r, c, s in zip(res.rows, res.cols, res.scores)
+        }
+        assert all(
+            got_scores[(r, c)] == scores[r, c] for (r, c) in want
+        )
+
+
+def test_simjoin_prunes_something(engine):
+    """The certificates must actually fire on a degree-grouped sweep —
+    a join that never prunes is just the brute force with extra steps."""
+    res = run_simjoin_campaign(engine, 0.3, grouping="degree")
+    assert res.block_pairs_pruned > 0
+    assert 0.0 < res.prune_ratio <= 1.0
+
+
+def test_simjoin_refuses_unsound_configs(hin, metapath, engine):
+    with pytest.raises(ValueError, match="rowsum"):
+        run_simjoin_campaign(
+            BatchEngine(hin, metapath, variant="diagonal",
+                        block_rows=32),
+            0.5,
+        )
+    with pytest.raises(ValueError, match="tau > 0"):
+        run_simjoin_campaign(engine, 0.0)
+
+
+def test_simjoin_resume_matches_straight_run(hin, metapath, tmp_path,
+                                             preemption):
+    eng = BatchEngine(hin, metapath, block_rows=32)
+    ref = run_simjoin_campaign(eng, 0.05, grouping="degree")
+    ck = str(tmp_path / "sj")
+
+    def on_block(done, total):
+        if done == 1:
+            preemption.request("test")
+
+    with pytest.raises(Preempted):
+        run_simjoin_campaign(eng, 0.05, grouping="degree",
+                             checkpoint_dir=ck, on_block=on_block)
+    preemption.reset()
+    res = run_simjoin_campaign(eng, 0.05, grouping="degree",
+                               checkpoint_dir=ck)
+    assert res.blocks_resumed == 1
+    assert np.array_equal(res.rows, ref.rows)
+    assert np.array_equal(res.cols, ref.cols)
+    assert np.array_equal(res.scores, ref.scores)
+
+
+# -- the batch_blocks wire op ----------------------------------------------
+
+
+def _replica(hin, metapath):
+    return PathSimService(
+        create_backend("numpy", hin, metapath),
+        config=ServeConfig(warm=False, max_wait_ms=0.5),
+    )
+
+
+def test_batch_blocks_protocol_parity_and_fences(hin, metapath, engine):
+    svc = _replica(hin, metapath)
+    try:
+        fp, seq = svc.consistency_token
+        resp = handle_request(svc, {
+            "id": 1, "op": "batch_blocks", "lo": 0, "hi": 32,
+            "mode": "topk", "k": 7, "variant": "rowsum",
+            "metapath": "APVPA", "base_fp": fp, "delta_seq": seq,
+        })
+        assert resp["ok"], resp
+        ref = run_topk_campaign(engine, 7)
+        assert np.array_equal(
+            np.asarray(resp["result"]["vals"]), ref.vals[:32]
+        )
+        assert np.array_equal(
+            np.asarray(resp["result"]["idxs"]), ref.idxs[:32]
+        )
+        # an empty request is a valid empty block (the protocol echo
+        # test drives every op with no fields)
+        resp = handle_request(svc, {"id": 2, "op": "batch_blocks"})
+        assert resp["ok"] and resp["result"]["vals"] == []
+        # graph-version fence
+        resp = handle_request(svc, {
+            "id": 3, "op": "batch_blocks", "lo": 0, "hi": 8,
+            "base_fp": "sha256:not-this-graph", "delta_seq": 0,
+        })
+        assert not resp["ok"] and "stale batch campaign" in resp["error"]
+        # metapath fence: same graph, different campaign chain
+        resp = handle_request(svc, {
+            "id": 4, "op": "batch_blocks", "lo": 0, "hi": 8,
+            "metapath": "APA",
+        })
+        assert not resp["ok"] and "stale batch campaign" in resp["error"]
+    finally:
+        svc.close()
+
+
+def test_batch_blocks_requires_replica(hin, metapath):
+    from distributed_pathsim_tpu.serving.partition import (
+        PartitionService,
+    )
+
+    svc = PartitionService(hin, metapath, 0, 2, replication=1)
+    resp = handle_request(svc, {"id": 1, "op": "batch_blocks"})
+    assert not resp["ok"] and "replica service" in resp["error"]
+
+
+# -- fleet fan-out ---------------------------------------------------------
+
+
+class _BatchFleet:
+    def __init__(self, hin, metapath, workers: int = 2, **sched_cfg):
+        self.services = [_replica(hin, metapath) for _ in range(workers)]
+        self.transports = {
+            f"w{i}": InprocTransport(
+                f"w{i}", WorkerRuntime(svc, worker_id=f"w{i}")
+            )
+            for i, svc in enumerate(self.services)
+        }
+        sched_cfg.setdefault("straggler_after_s", 5.0)
+        self.sched = BlockScheduler(self.transports, **sched_cfg)
+        self.sched.start()
+
+    def close(self):
+        self.sched.close()
+        for svc in self.services:
+            svc.close()
+
+
+def test_fleet_topk_bit_identical_to_single_host(hin, metapath, engine):
+    fleet = _BatchFleet(hin, metapath, workers=2)
+    try:
+        ref = run_topk_campaign(engine, 7)
+        res = run_topk_campaign(engine, 7, scheduler=fleet.sched)
+        assert res.backend_mode == "fleet"
+        assert np.array_equal(res.vals, ref.vals)
+        assert np.array_equal(res.idxs, ref.idxs)
+    finally:
+        fleet.close()
+
+
+def test_fleet_simjoin_bit_identical_to_pruned_single_host(
+    hin, metapath, engine
+):
+    fleet = _BatchFleet(hin, metapath, workers=2)
+    try:
+        ref = run_simjoin_campaign(engine, 0.05, grouping="degree")
+        res = run_simjoin_campaign(engine, 0.05, grouping="natural",
+                                   scheduler=fleet.sched)
+        assert sorted(zip(res.rows, res.cols, res.scores)) == sorted(
+            zip(ref.rows, ref.cols, ref.scores)
+        )
+    finally:
+        fleet.close()
+
+
+def test_fleet_worker_death_requeues_blocks(hin, metapath):
+    """Killing a worker mid-campaign loses no block: its outstanding
+    dispatches requeue to the survivor and the result is unchanged."""
+    eng = BatchEngine(hin, metapath, block_rows=16)
+    ref = run_topk_campaign(eng, 5)
+    fleet = _BatchFleet(hin, metapath, workers=2)
+    killed = {"done": False}
+
+    def on_block(done, total):
+        if not killed["done"]:
+            killed["done"] = True
+            fleet.transports["w1"].kill()
+
+    try:
+        res = run_topk_campaign(eng, 5, scheduler=fleet.sched,
+                                on_block=on_block)
+        assert np.array_equal(res.vals, ref.vals)
+        assert np.array_equal(res.idxs, ref.idxs)
+    finally:
+        fleet.close()
+
+
+def test_fleet_with_no_matching_token_refuses(hin, metapath):
+    """Workers serving a different graph than the campaign spec are
+    fenced; an all-fenced fleet refuses instead of mixing versions."""
+    hin2 = synthetic_hin(130, 240, 8, seed=99)
+    eng2 = BatchEngine(
+        hin2, compile_metapath("APVPA", hin2.schema), block_rows=32
+    )
+    fleet = _BatchFleet(hin, metapath, workers=1)
+    try:
+        with pytest.raises(BatchFleetError, match="no eligible"):
+            run_topk_campaign(eng2, 5, scheduler=fleet.sched)
+    finally:
+        fleet.close()
+
+
+# -- satellite 1: partition partial ops score through jax ------------------
+
+
+def test_partition_partial_ops_jax_numpy_bit_parity(hin, metapath):
+    """The jax-backed window counts and the numpy fallback produce
+    byte-identical partial_topk/partial_scores responses (exact
+    integer counts; the x64 guard keeps f64 on device)."""
+    from distributed_pathsim_tpu.ops.pathsim import jax_exact
+    from distributed_pathsim_tpu.serving.partition import (
+        PartitionService,
+    )
+
+    assert jax_exact() is not None, "tests run with x64 enabled"
+    svc = PartitionService(hin, metapath, 0, 1, replication=1)
+    # single partition: its own contribution IS the global colsum
+    agg: dict[int, float] = {}
+    for payload in svc.part_info({})["colsum"].values():
+        for c, v in zip(payload["cols"], payload["vals"]):
+            agg[c] = agg.get(c, 0.0) + v
+    svc.set_colsum({
+        "mode": "init",
+        "cols": list(agg), "vals": [agg[c] for c in agg],
+    })
+    assert svc.ready and svc._jax is not None
+    tile = svc.tile_pull({"row": 3})
+    req = {
+        "range": 0, "row": 3, "k": 9,
+        "cols": tile["cols"], "vals": tile["vals"],
+        "d_source": tile["d_source"],
+    }
+    jax_topk = svc.partial_topk(dict(req))
+    jax_scores = svc.partial_scores(dict(req))
+    svc._jax = None  # force the counted numpy fallback
+    np_topk = svc.partial_topk(dict(req))
+    np_scores = svc.partial_scores(dict(req))
+    assert jax_topk["cands"] == np_topk["cands"]
+    assert jax_scores["counts"] == np_scores["counts"]
+    assert jax_scores["denoms"] == np_scores["denoms"]
+
+
+# -- the bench smoke twin (make batch-smoke) -------------------------------
+
+
+def test_bench_batch_smoke(tmp_path):
+    """Twin of ``make batch-smoke``: parity, resume, prune-soundness,
+    and zero-steady-state-recompile gates on a small corpus, results
+    recorded to the BENCH_BATCH JSON shape."""
+    import bench_serving
+
+    out = tmp_path / "BENCH_BATCH_smoke.json"
+    bench_serving.run_batch_smoke(str(out))
+    data = json.loads(out.read_text())
+    assert all(data["smoke_checks"].values()), data["smoke_checks"]
