@@ -6,7 +6,7 @@ PYTHON ?= python
 .PHONY: test chaos chaos-router serve-smoke update-smoke obs-smoke \
 	router-smoke partition-smoke ann-smoke fleet-obs-smoke \
 	metapath-smoke compress-smoke firehose-smoke batch-smoke \
-	lint lint-schema \
+	learned-smoke lint lint-schema \
 	lint-telemetry tune-smoke lint-tuning tune
 
 # Tier-1: the fast CPU suite (the driver's acceptance gate).
@@ -92,6 +92,22 @@ update-smoke:
 # (tests/test_index.py::test_bench_ann_smoke), so tier-1 covers it.
 ann-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) bench_serving.py --regime ann --smoke
+
+# Learned smoke: distill a tiny two-tower index from the exact engine
+# in-process, serve exact/ann/learned closed-loop arms. Hard gates:
+# score recall@10 >= 0.99 at the shipped default knobs (every
+# returned score is exact-f64 reranked — only candidate coverage can
+# lose), zero steady-state XLA recompiles (the tower probe is numpy),
+# the cold-start exercise for real (a never-seen appended author
+# answers bit-identically through the counted 'stale' fallback before
+# any refresh, and through the learned arm after one O(delta)
+# inductive absorb — no retrain, no full re-embed), zero shed. QPS
+# claims are the full-size artifact's (BENCH_LEARNED_r19.json). The
+# same run is wired as a non-slow pytest
+# (tests/test_learned.py::test_bench_learned_smoke), so tier-1
+# covers it.
+learned-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) bench_serving.py --regime learned --smoke
 
 # Observability smoke: four arms (off / metrics / sampled tracing /
 # full tracing) interleaved on the same steady-state workload, with

@@ -1404,7 +1404,8 @@ def run_fleet_obs_smoke(out_path: str | None = None) -> dict:
     return result
 
 
-def _ann_recall_audit(ann_svc, exact_svc, rows, k: int) -> dict:
+def _ann_recall_audit(ann_svc, exact_svc, rows, k: int,
+                      mode: str = "ann") -> dict:
     """Measured recall@k + bit-parity of the ANN path vs the exact
     oracle over ``rows``. Two recall readings:
 
@@ -1425,7 +1426,7 @@ def _ann_recall_audit(ann_svc, exact_svc, rows, k: int) -> dict:
     recalls, id_recalls = [], []
     bit_identical = 0
     for row in rows:
-        av, ai = ann_svc.topk_index(int(row), k=k, mode="ann")
+        av, ai = ann_svc.topk_index(int(row), k=k, mode=mode)
         ev, ei = exact_svc.topk_index(int(row), k=k, mode="exact")
         want = [int(i) for i, v in zip(ei, ev) if np.isfinite(v)]
         got = {int(i) for i, v in zip(ai, av) if np.isfinite(v)}
@@ -1760,6 +1761,289 @@ def run_smoke(out_path: str | None = None) -> dict:
             json.dump(result, f, indent=2)
     if not all(checks.values()):
         raise AssertionError(f"serve smoke failed: {checks}")
+    return result
+
+
+# ---------------------------------------------------------------------------
+# Learned serving (--regime learned): two-tower candidate generation with
+# exact-f64 rerank, vs the exact and ann arms, plus the cold-start
+# exercise (ISSUE 19 / BENCH_LEARNED artifact)
+
+
+def _learned_cold_start_exercise(hin, backend, k, max_wait_ms, seed,
+                                 learned_steps,
+                                 learned_cand_mult=None) -> dict:
+    """The cold-start path, exercised for real: append a NEVER-SEEN
+    author (new row + edges in one delta, auto-refresh off) → the row
+    answers immediately in learned mode through the counted 'stale'
+    fallback, bit-identical to the exact oracle → ``refresh_towers``
+    re-embeds O(Δ) rows through the inductive encoder (no retrain, no
+    full re-embed) → the row answers through the learned arm proper,
+    still bit-identical. The timings are the cold-start-latency arm:
+    first answer after the delta, the absorb itself, and the first
+    post-absorb learned answer."""
+    from distributed_pathsim_tpu.data import delta as dl
+
+    hin2 = dl.with_headroom(hin, 0.25)
+    svc = _build_service(hin2, backend, max_batch=8,
+                         max_wait_ms=max_wait_ms, caches=False, k=k,
+                         topk_mode="learned", learned_shadow_every=0,
+                         learned_auto_refresh=False,
+                         learned_steps=learned_steps,
+                         learned_cand_mult=learned_cand_mult)
+    try:
+        n0 = svc.n  # the appended author's row index
+        rng = np.random.default_rng(seed)
+        papers = sorted({
+            int(p) for p in
+            rng.integers(0, hin.type_size("paper"), size=6)
+        })
+        info = svc.update(dl.DeltaBatch(
+            nodes=(dl.NodeAppend(node_type="author", count=1),),
+            edges=(dl.edge_delta(
+                "author_of", add=[[n0, p] for p in papers]
+            ),),
+        ))
+        pre_reason = svc.learned_fallback_reason(n0, "learned")
+        t0 = time.perf_counter()
+        lv, li = svc.topk_index(n0, k=k, mode="learned")
+        cold_ms = (time.perf_counter() - t0) * 1e3
+        ev, ei = svc.topk_index(n0, k=k, mode="exact")
+        pre_identical = bool(
+            np.array_equal(li, ei) and np.array_equal(lv, ev)
+        )
+        snap_pre = svc.stats()["learned"]
+        t0 = time.perf_counter()
+        refresh = svc.refresh_towers()
+        refresh_ms = (time.perf_counter() - t0) * 1e3
+        post_reason = svc.learned_fallback_reason(n0, "learned")
+        t0 = time.perf_counter()
+        lv2, li2 = svc.topk_index(n0, k=k, mode="learned")
+        post_ms = (time.perf_counter() - t0) * 1e3
+        post_identical = bool(
+            np.array_equal(li2, ei) and np.array_equal(lv2, ev)
+        )
+        snap_post = svc.stats()["learned"]
+        return {
+            "update_mode": info["mode"],
+            "stale_rows_after_update": info.get("learned_stale_rows"),
+            "pending_appends_after_update": info.get(
+                "learned_pending_appends"
+            ),
+            "pre_refresh_fallback_reason": pre_reason,
+            "pre_refresh_answer_bit_identical": pre_identical,
+            "cold_first_answer_ms": round(cold_ms, 3),
+            "cold_start_ratio_before_refresh": snap_pre[
+                "cold_start_ratio"
+            ],
+            "refresh": refresh,
+            "refresh_ms": round(refresh_ms, 3),
+            "post_refresh_fallback_reason": post_reason,
+            "post_refresh_answer_bit_identical": post_identical,
+            "post_refresh_answer_ms": round(post_ms, 3),
+            "cold_start_ratio_after_refresh": snap_post[
+                "cold_start_ratio"
+            ],
+        }
+    finally:
+        svc.close()
+
+
+def run_learned_bench(
+    n_authors: int = 2048,
+    n_papers: int = 4096,
+    n_venues: int = 48,
+    clients: int = 8,
+    queries_per_client: int = 32,
+    max_batch: int = 16,
+    max_wait_ms: float = 1.0,
+    reps: int = 3,
+    k: int = 10,
+    backend: str = "jax",
+    seed: int = 0,
+    oracle_samples: int = 128,
+    learned_steps: int = 3000,
+    learned_cand_mult: int = 32,
+) -> dict:
+    """Closed-loop exact-vs-ann-vs-learned arms on one graph (ISSUE
+    19): the learned arm distills two towers from the exact engine at
+    startup, probes them for C = cand_mult·k candidates (numpy, no XLA
+    at all on the probe), and exact-f64 reranks through the same
+    ``score_candidates`` doorway as ann — so its scores are exact by
+    construction, and recall is a question of candidate coverage only.
+    The full-size defaults train longer and shortlist wider than the
+    service's startup defaults (3000 steps / cand_mult 32 vs 200 / 16
+    — distillation budget scales with corpus; the tuning registry
+    races exactly these knobs recall-gated), which is what holds the
+    measured score-recall ≥ 0.99 gate at this N.
+    The artifact records QPS/latency per arm at two concurrency
+    points, measured score-recall + bit-parity vs the exact oracle for
+    BOTH approximate arms, steady-state XLA compile counts (must be
+    0), and the cold-start exercise (never-seen appended author:
+    answered through the counted fallback immediately, through the
+    towers after one O(Δ) absorb)."""
+    from distributed_pathsim_tpu.data.synthetic import synthetic_hin
+    from distributed_pathsim_tpu.utils import benchrunner as br
+    from distributed_pathsim_tpu.utils.xla_flags import CompileCounter
+
+    hin = synthetic_hin(n_authors, n_papers, n_venues, seed=seed)
+    rng = np.random.default_rng(seed)
+    n = hin.type_size("author")
+
+    exact_svc = _build_service(hin, backend, max_batch=max_batch,
+                               max_wait_ms=max_wait_ms, caches=False,
+                               k=k)
+    ann_svc = _build_service(hin, backend, max_batch=max_batch,
+                             max_wait_ms=max_wait_ms, caches=False,
+                             k=k, topk_mode="ann", ann_shadow_every=0)
+    t0 = time.perf_counter()
+    lrn_svc = _build_service(hin, backend, max_batch=max_batch,
+                             max_wait_ms=max_wait_ms, caches=False,
+                             k=k, topk_mode="learned",
+                             learned_shadow_every=0,
+                             learned_steps=learned_steps,
+                             learned_cand_mult=learned_cand_mult)
+    train_s = time.perf_counter() - t0
+    lrn_snapshot = lrn_svc.stats()["learned"]
+    if lrn_snapshot is None:
+        raise RuntimeError(
+            "learned tier failed to come up — see the "
+            "learned_unavailable runtime event"
+        )
+    try:
+        # degree>0 rows, same population rationale as run_ann_bench:
+        # zero-denominator rows answer exactly BY DESIGN (the
+        # 'degenerate' fallback) and are exercised in the tests
+        d = np.asarray(lrn_svc._learned.d)[:n]
+        eligible = np.flatnonzero(d > 0)
+
+        def one_round(svc, mode, cl):
+            sched = rng.choice(
+                eligible, size=(cl, queries_per_client)
+            )
+            return _run_clients(svc, sched.tolist(), k, mode=mode)
+
+        arms_fns = {}
+        for cl in (clients, 4 * clients):
+            arms_fns[f"exact_c{cl}"] = (
+                lambda cl=cl: one_round(exact_svc, "exact", cl)
+            )
+            arms_fns[f"ann_c{cl}"] = (
+                lambda cl=cl: one_round(ann_svc, "ann", cl)
+            )
+            arms_fns[f"learned_c{cl}"] = (
+                lambda cl=cl: one_round(lrn_svc, "learned", cl)
+            )
+        # warm every arm once (compiles, allocator), then measure with
+        # the compile ledger open: steady state must add nothing
+        for fn in arms_fns.values():
+            fn()
+        with CompileCounter() as cc:
+            runs = br.interleave(arms_fns, reps)
+        compiles = cc.count
+
+        med = br.median
+        arms_out = {}
+        for name, rs in runs.items():
+            arms_out[name] = {
+                "qps_median": med([r["qps"] for r in rs]),
+                "qps_best": max(r["qps"] for r in rs),
+                "p50_ms_median": med([r["p50_ms"] for r in rs]),
+                "p99_ms_median": med([r["p99_ms"] for r in rs]),
+                "shed": sum(r["shed"] for r in rs),
+                "runs": rs,
+            }
+        sample_rows = rng.choice(
+            eligible, size=min(oracle_samples, eligible.size),
+            replace=False,
+        )
+        recall = _ann_recall_audit(lrn_svc, exact_svc, sample_rows, k,
+                                   mode="learned")
+        ann_recall = _ann_recall_audit(ann_svc, exact_svc, sample_rows,
+                                       k, mode="ann")
+        cold = _learned_cold_start_exercise(hin, backend, k,
+                                            max_wait_ms, seed,
+                                            learned_steps,
+                                            learned_cand_mult)
+        return {
+            "graph": {"authors": n, "papers": n_papers,
+                      "venues": n_venues, "seed": seed},
+            "load": {"clients": clients,
+                     "queries_per_client": queries_per_client,
+                     "k": k, "max_batch": max_batch,
+                     "max_wait_ms": max_wait_ms, "reps": reps,
+                     "eligible_rows": int(eligible.size)},
+            "backend": backend,
+            "learned_state": lrn_snapshot,
+            "train_startup_s": round(train_s, 3),
+            "arms": arms_out,
+            "recall": recall,
+            "ann_recall": ann_recall,
+            "steady_state_compiles": compiles,
+            "cold_start": cold,
+            "estimator_note": (
+                "arms interleaved per round (utils/benchrunner.py). "
+                "Recall/bit-parity, compile counts, and the cold-start "
+                "exercise are deterministic gates; QPS is the "
+                "box-dependent claim. The learned probe is a numpy "
+                "tower matmul — its win over exact is O(C) rerank vs "
+                "O(N) scan, and over ann it trades index rebuild cost "
+                "for O(Δ) inductive absorbs on delta landings."
+            ),
+        }
+    finally:
+        exact_svc.close()
+        ann_svc.close()
+        lrn_svc.close()
+
+
+def run_learned_smoke(out_path: str | None = None) -> dict:
+    """The tier-1 learned gate (``make learned-smoke``): distill a
+    tiny tower in-process on a synthetic graph, serve all three arms,
+    and hard-gate what is deterministic on shared hardware — score
+    recall@10 ≥ 0.99 at the shipped default knobs (exact rerank makes
+    every returned score exact; only coverage can lose), ZERO
+    steady-state XLA recompiles (the probe is numpy; the rerank rides
+    the warmed exact buckets), the cold-start exercise for real (a
+    never-seen appended author answers bit-identically through the
+    counted 'stale' fallback BEFORE any refresh, and through the
+    learned arm after one O(Δ) absorb), and zero shed. QPS claims
+    belong to the full-size artifact (BENCH_LEARNED_r19.json)."""
+    result = run_learned_bench(
+        n_authors=768, n_papers=1280, n_venues=16,
+        clients=6, queries_per_client=16,
+        max_batch=8, max_wait_ms=1.0, reps=2, k=10,
+        oracle_samples=48, learned_steps=120, learned_cand_mult=16,
+    )
+    cs = result["cold_start"]
+    checks = {
+        "recall_ge_0_99": result["recall"]["recall_at_k"] >= 0.99,
+        "zero_steady_state_compiles": (
+            result["steady_state_compiles"] == 0
+        ),
+        "cold_start_answered_before_refresh": (
+            cs["update_mode"] == "delta"
+            and cs["pending_appends_after_update"] == 1
+            and cs["pre_refresh_fallback_reason"] == "stale"
+            and cs["pre_refresh_answer_bit_identical"]
+        ),
+        "refresh_restores_learned": (
+            cs["refresh"]["appended"] == 1
+            and cs["refresh"]["pending_appends"] == 0
+            and cs["post_refresh_fallback_reason"] is None
+            and cs["post_refresh_answer_bit_identical"]
+            and cs["cold_start_ratio_after_refresh"] == 1.0
+        ),
+        "zero_shed": all(
+            a["shed"] == 0 for a in result["arms"].values()
+        ),
+    }
+    result["smoke_checks"] = checks
+    if out_path:
+        with open(out_path, "w", encoding="utf-8") as f:
+            json.dump(result, f, indent=2)
+    if not all(checks.values()):
+        raise AssertionError(f"learned smoke failed: {checks}")
     return result
 
 
@@ -3695,7 +3979,7 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--regime", default="load",
                    choices=("load", "update", "obs", "router", "ann",
                             "fleet-obs", "partition", "metapath",
-                            "compress", "firehose", "batch"),
+                            "compress", "firehose", "batch", "learned"),
                    help="'load': the closed-loop QPS regimes; 'update': "
                    "delta-ingestion vs reload latency; 'obs': "
                    "observability overhead (obs on vs off, steady "
@@ -3712,7 +3996,10 @@ def main(argv: list[str] | None = None) -> int:
                    "autoscale load step (BENCH_FIREHOSE artifact); "
                    "'batch': corpus-sweep campaigns — top-k-all + "
                    "threshold simjoin, single-host and fleet arms, "
-                   "resume + parity gates (BENCH_BATCH artifact)")
+                   "resume + parity gates (BENCH_BATCH artifact); "
+                   "'learned': exact-vs-ann-vs-learned closed-loop "
+                   "arms with measured recall vs the exact oracle and "
+                   "the cold-start exercise (BENCH_LEARNED artifact)")
     p.add_argument("--deltas", type=int, default=10_000,
                    help="firehose regime: sustained updates in phase 1")
     p.add_argument("--replicas", default="1,2,4",
@@ -3736,7 +4023,22 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--out", default=None, help="write the JSON here")
     args = p.parse_args(argv)
 
-    if args.regime == "batch":
+    if args.regime == "learned":
+        if args.smoke:
+            result = run_learned_smoke(args.out)
+        else:
+            result = run_learned_bench(
+                n_authors=args.authors, n_papers=args.papers,
+                n_venues=args.venues, clients=args.clients,
+                queries_per_client=args.queries_per_client,
+                max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
+                reps=args.reps, k=args.k, backend=args.backend,
+                seed=args.seed,
+            )
+            if args.out:
+                with open(args.out, "w", encoding="utf-8") as f:
+                    json.dump(result, f, indent=2)
+    elif args.regime == "batch":
         if args.smoke:
             result = run_batch_smoke(args.out)
         else:

@@ -152,7 +152,7 @@ class CallGraph:
         stripping the package name (the package root is a walk root)."""
         out: dict[str, tuple] = {}
         pkg_prefix = "distributed_pathsim_tpu"
-        for node in ast.walk(m.tree):
+        for node in m.nodes:
             if isinstance(node, ast.Import):
                 for alias in node.names:
                     parts = alias.name.split(".")

@@ -77,7 +77,7 @@ class CompressedLayoutPass:
             if m.rel in _ALLOWED:
                 continue
             index = None
-            for node in ast.walk(m.tree):
+            for node in m.nodes:
                 if (
                     isinstance(node, ast.Attribute)
                     and node.attr in surface

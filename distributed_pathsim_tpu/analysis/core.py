@@ -33,6 +33,7 @@ from __future__ import annotations
 import ast
 import dataclasses
 import datetime
+import functools
 import json
 import pathlib
 
@@ -74,6 +75,13 @@ class Module:
     @property
     def lines(self) -> list[str]:
         return self.text.splitlines()
+
+    @functools.cached_property
+    def nodes(self) -> tuple:
+        """Flat walk of the whole tree, computed once and shared by
+        every pass — full-module scans dominate the tier-1 analysis
+        budget, so passes iterate this instead of re-walking."""
+        return tuple(ast.walk(self.tree))
 
 
 def repo_root() -> pathlib.Path:

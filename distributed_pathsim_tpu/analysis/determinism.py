@@ -208,7 +208,7 @@ class DeterminismPass:
         if m.rel in _WALLCLOCK_ALLOWED:
             return
         index = None
-        for node in ast.walk(m.tree):
+        for node in m.nodes:
             if isinstance(node, ast.Call) and call_name(node) == "time.time":
                 if index is None:
                     index = qualname_index(m.tree)
@@ -224,7 +224,7 @@ class DeterminismPass:
 
     def _dt004(self, m: Module, findings: list[Finding]) -> None:
         index = None
-        for node in ast.walk(m.tree):
+        for node in m.nodes:
             if not isinstance(node, ast.Call):
                 continue
             cn = call_name(node) or ""

@@ -57,7 +57,7 @@ class PartitionOwnershipPass:
             if m.root_kind != "package" or m.rel in _ALLOWED:
                 continue
             index = None
-            for node in ast.walk(m.tree):
+            for node in m.nodes:
                 if (
                     isinstance(node, ast.Attribute)
                     and node.attr in surface

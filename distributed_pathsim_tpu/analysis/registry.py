@@ -18,6 +18,7 @@ from .compress_rules import CompressedLayoutPass
 from .determinism import DeterminismPass
 from .exceptions import ExceptionSafetyPass
 from .interlocks import InterLockPass
+from .learned_rules import LearnedDoorwayPass
 from .locks import LockDisciplinePass
 from .metapath_ir import MetapathIRPass
 from .partition import PartitionOwnershipPass
@@ -54,6 +55,7 @@ PASS_FAMILIES: dict[str, str] = {
                             "interprocedural (CF)",
     "CompactionDoorwayPass": "compaction swap doorway (CP)",
     "BatchDoorwayPass": "batch block-sweep doorway (BT)",
+    "LearnedDoorwayPass": "learned score doorway (LN)",
 }
 
 ALL_PASSES = (
@@ -71,6 +73,7 @@ ALL_PASSES = (
     CompressedLayoutPass(),
     CompactionDoorwayPass(),
     BatchDoorwayPass(),
+    LearnedDoorwayPass(),
 )
 
 RULES: dict[str, RuleDoc] = {}

@@ -53,7 +53,7 @@ class TelemetryPass:
                 continue
             index = None
             if m.rel not in _STDERR_ALLOWED:
-                for node in ast.walk(m.tree):
+                for node in m.nodes:
                     if is_print_call(node) and print_stream(node) == "stderr":
                         if index is None:
                             index = qualname_index(m.tree)
@@ -67,7 +67,7 @@ class TelemetryPass:
                             ),
                         ))
             if m.rel not in _SINK_ALLOWED:
-                for node in ast.walk(m.tree):
+                for node in m.nodes:
                     if (
                         isinstance(node, (ast.Name, ast.Attribute))
                         and getattr(node, "id", getattr(node, "attr", None))

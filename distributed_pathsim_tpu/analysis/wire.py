@@ -218,7 +218,7 @@ class WireContractPass:
             if not m.rel.startswith(prefix) or m.rel in allowed:
                 continue
             index = qualname_index(m.tree)
-            for node in ast.walk(m.tree):
+            for node in m.nodes:
                 if is_print_call(node):
                     findings.append(Finding(
                         path=m.repo_rel, line=node.lineno, rule="WC003",
@@ -234,7 +234,7 @@ class WireContractPass:
         if m.rel in _STREAM_WRITE_ALLOWED:
             return
         index = None
-        for node in ast.walk(m.tree):
+        for node in m.nodes:
             if (
                 isinstance(node, ast.Attribute)
                 and node.attr == "write"

@@ -476,7 +476,7 @@ class _SchemaBuilder:
 
         for m in self.modules:
             index = None
-            for node in ast.walk(m.tree):
+            for node in m.nodes:
                 if not isinstance(node, ast.Dict):
                     continue
                 ops_here: list[str] = []

@@ -250,6 +250,18 @@ def main(argv: list[str] | None = None) -> int:
             msg = exc.args[0] if exc.args else exc
             print(f"error: {msg}", file=sys.stderr)
             return 1
+    if argv and argv[0] == "learned":
+        # ``dpathsim learned train/inspect`` — two-tower serving
+        # checkpoints distilled from the exact engine for
+        # `serve --topk-mode learned` (learned/cli.py).
+        from .learned.cli import learned_main
+
+        try:
+            return learned_main(argv[1:])
+        except (KeyError, ValueError, FileNotFoundError) as exc:
+            msg = exc.args[0] if exc.args else exc
+            print(f"error: {msg}", file=sys.stderr)
+            return 1
     if argv and argv[0] == "lint":
         # ``dpathsim lint`` — the unified invariant-checking static
         # analyzer (analysis/): recompile-safety, lock-discipline,

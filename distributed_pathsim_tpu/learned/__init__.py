@@ -1,0 +1,36 @@
+"""Learned serving tier: inductive two-tower index, exact-reranked.
+
+The ``--topk-mode learned`` arm of the serving stack (DESIGN.md §32).
+Four pieces, promoted from the ``models/neural.py`` trainer into a
+first-class candidate-generation subsystem with ANN's safety story:
+
+- :mod:`.trainer` — online distillation from the exact engine: the
+  teacher is the exact score itself (hard-candidate mining) plus the
+  batch tier's ``--emit-pairs`` JSONL stream;
+- :mod:`.encoder` — the inductive half: a pure-numpy tower forward
+  over ROW-LOCAL features, so a node the index has never seen embeds
+  from its typed adjacency alone (cold-start answering);
+- :mod:`.checkpoint` — versioned, fingerprint-keyed tower artifacts
+  with atomic save/load (the ``index/mips.py`` contract);
+- :mod:`.serving` — the query-path state: towers generate candidates
+  ONLY, every answer is exact-f64 reranked inside this package
+  (analyzer rule LN001 seals the raw-score surface), a shadow-recall
+  gate disables the arm below floor, and every degradation is a
+  counted fallback to ANN-then-exact.
+"""
+
+from .checkpoint import TowerMismatch, load_towers, save_towers
+from .encoder import InductiveEncoder
+from .serving import LEARNED_FALLBACK_REASONS, LEARNED_SURFACE, LearnedState
+from .trainer import train_towers
+
+__all__ = [
+    "InductiveEncoder",
+    "LEARNED_FALLBACK_REASONS",
+    "LEARNED_SURFACE",
+    "LearnedState",
+    "TowerMismatch",
+    "load_towers",
+    "save_towers",
+    "train_towers",
+]

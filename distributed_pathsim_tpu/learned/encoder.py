@@ -1,0 +1,149 @@
+"""Inductive tower encoder: a pure-numpy forward over row-local features.
+
+The inductive contract (DESIGN.md §32): every input the tower reads for
+node ``j`` is computable from that node's OWN half-chain row ``C_j``
+and denominator ``d_j`` once three train-time constants are pinned —
+the Cauchy quadrature grid ``(t, w)``, the degree normalizer
+``deg_denom`` and the calibration ``target_scale``. Nothing in the
+feature map looks at any other row, so a node appended after training
+embeds from its typed adjacency alone, and its embedding is
+inner-product-consistent with the corpus embeddings by construction.
+
+The forward is plain numpy (three Dense+relu layers — the exact
+architecture of ``models/neural.TwoTower``, parameters exported from
+the trained flax pytree). Two reasons it is NOT a jax call:
+
+- serving's steady-state zero-recompile contract holds trivially — a
+  cold-start re-embed of Δ rows compiles nothing because there is
+  nothing to compile;
+- corpus rows and cold-start rows go through the SAME arithmetic, so
+  "inductively embedded" and "trained-corpus" embeddings can never
+  drift by a compiler's reassociation.
+
+The feature map mirrors ``NeuralPathSim._setup_from_c`` exactly
+(unit-L2 C row | scaled log-degree | quadrature gates); the
+``feature_format`` stamp in checkpoints exists so a map change here
+fails a stale artifact loudly instead of silently skewing candidates.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+# One definition of the feature-map identity, stamped into checkpoints
+# and verified on load (the _OPT_FORMAT pattern of models/neural.py).
+FEATURE_FORMAT = "l2c-deg-gates-r04"
+
+
+def _gates(d: np.ndarray, t: np.ndarray) -> np.ndarray:
+    """Quadrature denominator gates e^(-d·t_k) — same arithmetic as
+    ``models.neural.quadrature_gates``, duplicated here in plain numpy
+    so loading a checkpoint never imports flax/optax (the serving
+    worker may hold towers without ever training)."""
+    return np.exp(
+        -np.clip(
+            np.asarray(d, np.float64)[:, None] * np.asarray(t)[None, :],
+            0.0, 700.0,
+        )
+    ).astype(np.float32)
+
+
+@dataclasses.dataclass
+class InductiveEncoder:
+    """Frozen trained towers + the pinned train-time constants.
+
+    ``layers`` is ``[(kernel, bias), ...]`` for the three Dense layers
+    (f32). ``v`` is the half-chain contraction width the towers were
+    trained on — a graph whose venue vocabulary grew past it cannot be
+    embedded without retraining (the feature dimension moved), which
+    callers must treat as a counted degradation, not an error.
+    """
+
+    layers: list[tuple[np.ndarray, np.ndarray]]
+    quad_t: np.ndarray
+    quad_w: np.ndarray
+    deg_denom: float
+    target_scale: float
+    variant: str
+    metapath: str
+    meta: dict
+
+    def __post_init__(self):
+        if len(self.layers) != 3:
+            raise ValueError(
+                f"expected 3 tower layers, got {len(self.layers)}"
+            )
+        for kern, bias in self.layers:
+            kern.flags.writeable = False
+            bias.flags.writeable = False
+
+    @property
+    def v(self) -> int:
+        """Contraction width of the training graph's half factor."""
+        return int(self.layers[0][0].shape[0]) - 1 - len(self.quad_t)
+
+    @property
+    def dim(self) -> int:
+        return int(self.layers[-1][0].shape[1])
+
+    @property
+    def hidden(self) -> int:
+        return int(self.layers[0][0].shape[1])
+
+    @classmethod
+    def from_model(cls, model, meta: dict | None = None) -> "InductiveEncoder":
+        """Export a trained :class:`~..models.neural.NeuralPathSim`'s
+        towers into the numpy form (flax pytree → plain arrays)."""
+        params = model.state.params["params"]
+        layers = [
+            (
+                np.array(params[f"Dense_{i}"]["kernel"], dtype=np.float32),
+                np.array(params[f"Dense_{i}"]["bias"], dtype=np.float32),
+            )
+            for i in range(3)
+        ]
+        deg = np.log1p(model._d)
+        return cls(
+            layers=layers,
+            quad_t=np.asarray(model._quad_t, dtype=np.float64),
+            quad_w=np.asarray(model._quad_w, dtype=np.float64),
+            deg_denom=max(float(deg.max(initial=0.0)), 1.0),
+            target_scale=float(model.target_scale),
+            variant=model.variant,
+            metapath=model.metapath.name,
+            meta=dict(meta or {}),
+        )
+
+    # -- the row-local feature map ----------------------------------------
+
+    def features(self, c_rows: np.ndarray, d_rows: np.ndarray) -> np.ndarray:
+        """[B, V] half-chain rows + [B] denominators → [B, F] tower
+        inputs. Row-local by construction: the three corpus statistics
+        this normalization needs (quadrature grid, degree max) are the
+        PINNED train-time constants, not recomputed."""
+        c_rows = np.asarray(c_rows, dtype=np.float32)
+        if c_rows.ndim != 2 or c_rows.shape[1] != self.v:
+            raise ValueError(
+                f"half-chain width {c_rows.shape} does not match the "
+                f"towers' training width V={self.v} — the contraction "
+                "vocabulary changed; retrain"
+            )
+        d_rows = np.asarray(d_rows, dtype=np.float64)
+        norms = np.linalg.norm(c_rows, axis=1, keepdims=True)
+        c_norm = c_rows / np.where(norms > 0, norms, 1)
+        deg = (np.log1p(d_rows) / self.deg_denom).astype(np.float32)
+        return np.concatenate(
+            [c_norm, deg[:, None], _gates(d_rows, self.quad_t)], axis=1
+        )
+
+    def embed(self, c_rows: np.ndarray, d_rows: np.ndarray) -> np.ndarray:
+        """Embed rows through the frozen towers: [B, dim] f32. Pure
+        numpy — zero XLA involvement, so a serving-path re-embed can
+        never recompile anything."""
+        x = self.features(c_rows, d_rows)
+        (w0, b0), (w1, b1), (w2, b2) = self.layers
+        x = np.maximum(x @ w0 + b0, 0.0)
+        x = np.maximum(x @ w1 + b1, 0.0)
+        return x @ w2 + b2

@@ -1,0 +1,400 @@
+"""Serving-side learned-tower state: candidates → exact f64 rerank.
+
+The service owns one :class:`LearnedState` when ``--topk-mode
+learned`` (or ``--learned-checkpoint``) is configured. Safety story,
+identical in shape to the ANN arm (serving/ann.py) and provably safe
+by construction:
+
+- the towers ONLY generate candidates. Every served answer is
+  exact-f64 reranked through the same candidate-restricted primitives
+  the exact engine uses (``ops/pathsim.score_candidates`` /
+  ``topk_from_candidate_scores``) against the C/d snapshot, so a
+  learned answer is bit-identical to the full exact top-k whenever the
+  true top-k is inside the candidate set — and the shadow gate
+  MEASURES how often that holds;
+- **shadow-recall confidence**: every Nth learned dispatch also runs
+  the exact oracle; measured score-recall below the floor disables the
+  learned arm (every query degrades, counted) until a refresh;
+- **cold start**: rows appended after training re-embed through the
+  inductive encoder's row-local numpy forward — O(Δ) tower work, no
+  full corpus re-embed, zero XLA compiles.
+
+Fallback taxonomy (``dpathsim_learned_fallbacks_total{reason=...}``):
+``no_towers``, ``stale``, ``uncovered``, ``degenerate``,
+``low_confidence``, ``metapath``. Every degradation falls to
+ANN-then-exact in the service's admission cascade.
+
+**The LN001 doorway** (DESIGN.md §32): raw tower similarity scores are
+approximations and must NEVER reach a host boundary unreranked — an
+operator reading them as PathSim scores would be silently wrong in
+score units. ``LEARNED_SURFACE`` names the raw-score internals
+(parsed by the analyzer as a literal, the CF001/BT001 pattern); any
+attribute access outside ``learned/`` is flagged. Callers hold the
+probe result as an opaque handle and get answers only through
+:meth:`LearnedState.answer_from_handle`, which reranks inside this
+module.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from ..obs.metrics import get_registry
+from ..ops import pathsim
+from ..utils.logging import runtime_event
+
+LEARNED_FALLBACK_REASONS = (
+    "no_towers", "stale", "uncovered", "degenerate", "low_confidence",
+    "metapath",
+)
+
+# The sealed raw-score surface (analyzer rule LN001): attributes that
+# read or carry UNRERANKED tower similarities. Only modules inside
+# learned/ may touch them; everyone else gets exact-reranked answers
+# through answer_from_handle. Parsed by the analyzer as a literal so
+# the rule and this registry cannot drift.
+LEARNED_SURFACE = frozenset({
+    "tower_sims",
+    "raw_sims",
+})
+
+
+class ProbeHandle:
+    """Opaque carrier of one batch's raw tower similarities between
+    the dispatcher and completion threads. The payload attribute is
+    LN001-sealed: unwrap only inside learned/."""
+
+    __slots__ = ("raw_sims",)
+
+    def __init__(self, sims: np.ndarray):
+        self.raw_sims = sims
+
+
+class LearnedState:
+    """One service's learned answering state. Thread discipline
+    mirrors :class:`~..serving.ann.AnnState`: eligibility under the
+    service's swap lock; probe on the dispatcher thread (host numpy);
+    rerank/shadow on the completion thread; absorb/refresh under the
+    swap lock with the pipeline drained."""
+
+    def __init__(
+        self,
+        encoder,
+        c64: np.ndarray,
+        d: np.ndarray,
+        cand_mult: int = 16,
+        shadow_every: int = 64,
+        recall_floor: float = 0.98,
+        min_shadow: int = 8,
+        token: tuple[str, int] = ("", 0),
+    ):
+        self.encoder = encoder
+        self.c64 = np.asarray(c64, dtype=np.float64)
+        self.c64.flags.writeable = False
+        self.d = np.asarray(d, dtype=np.float64)
+        self.n = int(self.d.shape[0])
+        # corpus embeddings through the SAME numpy forward cold-start
+        # rows use — consistent by construction, compiles nothing
+        self._emb = encoder.embed(self.c64, self.d)
+        self._emb.flags.writeable = False
+        self.stale = np.zeros(self.n, dtype=bool)
+        self.token = (str(token[0]), int(token[1]))
+        self.cand_mult = int(cand_mult)
+        self.shadow_every = max(int(shadow_every), 0)
+        self.recall_floor = float(recall_floor)
+        self.min_shadow = int(min_shadow)
+        self.enabled = True
+        # independent per-request reranks fan over a small pool (numpy
+        # releases the GIL) instead of serializing on the completion
+        # thread — same sizing as the ANN rerank pool
+        self.pool = ThreadPoolExecutor(
+            max_workers=max(2, min(4, os.cpu_count() or 2)),
+            thread_name_prefix="pathsim-learned-rerank",
+        )
+        self._lock = threading.Lock()
+        self.shadow_n = 0
+        self.recall_sum = 0.0
+        self._since_shadow = 0
+        # cold-start accounting: appended source rows (they land in
+        # headroom slots, embedded as zero rows at build — a delta
+        # makes them real and stale) that absorb has not re-embedded
+        # yet. seen is cumulative; pending drains to 0 per absorb.
+        self.appended_seen = 0
+        self._appended_pending = 0
+        reg = get_registry()
+        self._m_requests = reg.counter(
+            "dpathsim_learned_requests_total",
+            "topk requests answered through the learned path",
+        ).labels()
+        self._m_fallbacks = reg.counter(
+            "dpathsim_learned_fallbacks_total",
+            "learned-requested queries degraded to ann/exact, by reason",
+        )
+        self._m_recall = reg.gauge(
+            "dpathsim_learned_recall_ratio",
+            "measured shadow score-recall@k of the learned path vs the "
+            "exact oracle (cumulative over the shadow samples)",
+        ).labels()
+        self._m_recall.set(1.0)
+        self._m_cold = reg.gauge(
+            "dpathsim_learned_cold_start_ratio",
+            "fraction of appended (cold-start) rows the learned path "
+            "can already answer (1.0 = every append absorbed)",
+        ).labels()
+        self._m_cold.set(1.0)
+        self._m_probe = reg.histogram(
+            "dpathsim_learned_probe_seconds",
+            "learned candidate-generation (tower matmul) latency per "
+            "batch",
+        ).labels()
+        self._m_rerank = reg.histogram(
+            "dpathsim_learned_rerank_seconds",
+            "exact candidate rerank latency per request",
+        ).labels()
+
+    # -- eligibility -------------------------------------------------------
+
+    def peek(self, row: int) -> str | None:
+        """Eligibility WITHOUT the counter side effect (the worker's
+        response annotation and the flight recorder read this; only
+        the answering path counts)."""
+        with self._lock:
+            enabled = self.enabled
+        if not enabled:
+            return "low_confidence"
+        if not 0 <= row < self.n:
+            return "uncovered"
+        if self.stale[row]:
+            return "stale"
+        if self.d[row] <= 0:
+            return "degenerate"
+        return None
+
+    def eligible(self, row: int) -> str | None:
+        """None when the learned path may answer ``row``; otherwise
+        the fallback reason (also counted)."""
+        reason = self.peek(row)
+        if reason is not None:
+            self.note_fallback(reason)
+        return reason
+
+    def note_fallback(self, reason: str) -> None:
+        self._m_fallbacks.inc(reason=reason)
+
+    # -- probe + exact rerank ----------------------------------------------
+
+    def tower_sims(self, rows: np.ndarray) -> np.ndarray:
+        """Raw tower similarities [B, N] — LN001-sealed: approximate
+        score-scale numbers that must never leave learned/ unreranked."""
+        return self._emb[rows] @ self._emb.T
+
+    def probe_batch(self, rows: np.ndarray) -> ProbeHandle:
+        """Dispatcher-thread half: one host matmul over the tower
+        embeddings (O(B·N·dim) f32 — no device, no compile). Returns
+        the opaque handle the completion half unwraps."""
+        return ProbeHandle(self.tower_sims(np.asarray(rows)))
+
+    def answer_from_handle(self, handle: ProbeHandle, b: int,
+                           row: int, k: int):
+        """Completion half for one request: select C = cand_mult·k
+        candidates from the probed similarities and exact-f64 rerank
+        them INSIDE this module — the only way an answer leaves the
+        learned tier. Stale candidates are sound: only the QUERY row's
+        freshness matters (an unaffected query row's entire exact
+        score row is unchanged by the delta — the affected-rows
+        superset guarantee), and a stale query never reaches here."""
+        sims = handle.raw_sims[b].astype(np.float64, copy=True)
+        sims[row] = -np.inf
+        n_cand = max(k, min(self.cand_mult * k, self.n - 1))
+        cand = np.argpartition(-sims, min(n_cand, self.n - 1))[:n_cand]
+        cand = cand[cand != row].astype(np.int64)
+        return self.rerank(row, cand, k)
+
+    def rerank(self, row: int, cand: np.ndarray, k: int):
+        """Exact f64 top-k over the candidate set: integer counts from
+        the C snapshot, shared normalize + tie order with the full
+        exact path — bit-identical to the full-row answer whenever the
+        true top-k is inside ``cand``."""
+        cand = np.asarray(cand, dtype=np.int64)
+        counts = self.c64[cand] @ self.c64[row]
+        scores = pathsim.score_candidates(
+            counts[None, :], np.asarray([self.d[row]]),
+            self.d[cand][None, :],
+        )
+        vals, idxs = pathsim.topk_from_candidate_scores(
+            scores, cand[None, :], k
+        )
+        return vals[0], idxs[0]
+
+    # -- staleness + cold-start absorption ---------------------------------
+
+    @property
+    def stale_count(self) -> int:
+        return int(self.stale.sum())
+
+    @property
+    def pending_appends(self) -> int:
+        with self._lock:
+            return self._appended_pending
+
+    def mark_stale(self, rows: np.ndarray) -> int:
+        """Fence delta-affected rows onto the fallback path until a
+        refresh re-embeds them (the PR-7 staleness contract)."""
+        rows = np.asarray(rows)
+        rows = rows[(rows >= 0) & (rows < self.n)]
+        self.stale[rows] = True
+        return int(rows.size)
+
+    def note_appends(self, n_rows: int) -> None:
+        """Record ``n_rows`` freshly appended source rows (cold-start
+        authors): answered by counted fallback until :meth:`absorb`
+        re-embeds them through the inductive encoder. Feeds the
+        ``cold_start_answerable`` SLO gauge."""
+        with self._lock:
+            if n_rows > 0:
+                self.appended_seen += int(n_rows)
+                self._appended_pending += int(n_rows)
+            pending = self._appended_pending
+            seen = self.appended_seen
+        self._m_cold.set(
+            (seen - pending) / seen if seen else 1.0
+        )
+
+    def absorb(self, c_new: np.ndarray, d_new: np.ndarray,
+               token: tuple[str, int]) -> dict:
+        """Swap in the patched graph's C/d snapshot and re-embed ONLY
+        the stale + appended rows through the inductive encoder — the
+        O(Δ) "before any full re-embed" cold-start path. Caller holds
+        the service swap lock with the pipeline drained. Raises
+        ``ValueError`` when the contraction width changed (new venue
+        vocabulary → feature space moved; retrain)."""
+        c_new = np.asarray(c_new, dtype=np.float64)
+        d_new = np.asarray(d_new, dtype=np.float64)
+        n_new = int(d_new.shape[0])
+        n_keep = min(self.n, n_new)
+        need = np.flatnonzero(self.stale[:n_keep])
+        appended = np.arange(n_keep, n_new, dtype=np.int64)
+        rows = np.concatenate([need, appended])
+        emb = np.empty((n_new, self._emb.shape[1]), dtype=np.float32)
+        emb[:n_keep] = self._emb[:n_keep]
+        if rows.size:
+            # encoder.embed validates the width and raises before any
+            # state moved — absorb is all-or-nothing
+            emb[rows] = self.encoder.embed(c_new[rows], d_new[rows])
+        c_new.flags.writeable = False
+        emb.flags.writeable = False
+        self.c64 = c_new
+        self.d = d_new
+        self._emb = emb
+        self.n = n_new
+        self.stale = np.zeros(n_new, dtype=bool)
+        self.token = (str(token[0]), int(token[1]))
+        with self._lock:
+            absorbed = self._appended_pending
+            self._appended_pending = 0
+        self.note_appends(0)  # republish the gauge (pending now 0)
+        return {
+            "re_embedded": int(rows.size),
+            "appended": absorbed,
+        }
+
+    # -- shadow-recall confidence ------------------------------------------
+
+    def should_shadow(self) -> bool:
+        if self.shadow_every <= 0:
+            return False
+        with self._lock:
+            self._since_shadow += 1
+            if self._since_shadow >= self.shadow_every:
+                self._since_shadow = 0
+                return True
+        return False
+
+    def record_shadow(self, got_vals, exact_vals, k: int) -> None:
+        """Fold one shadow comparison into the confidence gate —
+        SCORE recall@k, same metric and tie reasoning as the ANN gate
+        (a returned item whose exact score clears the oracle's k-th
+        score is a hit; learned answers are exact-reranked, so the
+        comparison is bit-meaningful)."""
+        ev = np.asarray(exact_vals)
+        gv = np.asarray(got_vals)
+        want = ev[np.isfinite(ev)]
+        if want.size == 0:
+            return
+        kth = want.min()
+        got = gv[np.isfinite(gv)]
+        recall = min(float((got >= kth).sum()) / float(want.size), 1.0)
+        with self._lock:
+            self.shadow_n += 1
+            self.recall_sum += recall
+            ratio = self.recall_sum / self.shadow_n
+            tripped = (
+                self.enabled
+                and self.shadow_n >= self.min_shadow
+                and ratio < self.recall_floor
+            )
+            if tripped:
+                self.enabled = False
+            samples = self.shadow_n
+        self._m_recall.set(ratio)
+        if tripped:
+            runtime_event(
+                "learned_confidence_lost",
+                recall=round(ratio, 4),
+                floor=self.recall_floor,
+                samples=samples,
+            )
+
+    def reset_confidence(self) -> None:
+        """After an absorb/retrain the old shadow evidence describes a
+        different tower state — start the gate fresh."""
+        with self._lock:
+            self.shadow_n = 0
+            self.recall_sum = 0.0
+            self._since_shadow = 0
+            self.enabled = True
+        self._m_recall.set(1.0)
+
+    def close(self) -> None:
+        self.pool.shutdown(wait=False)
+
+    # -- accounting --------------------------------------------------------
+
+    def count_answered(self) -> None:
+        self._m_requests.inc()
+
+    def observe_probe(self, seconds: float) -> None:
+        self._m_probe.observe(seconds)
+
+    def observe_rerank(self, seconds: float) -> None:
+        self._m_rerank.observe(seconds)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            shadow_n = self.shadow_n
+            ratio = self.recall_sum / shadow_n if shadow_n else None
+            pending = self._appended_pending
+            seen = self.appended_seen
+            enabled = self.enabled
+        return {
+            "enabled": enabled,
+            "dim": self.encoder.dim,
+            "hidden": self.encoder.hidden,
+            "cand_mult": self.cand_mult,
+            "embedded_rows": self.n,
+            "stale_rows": self.stale_count,
+            "pending_appends": pending,
+            "appended_seen": seen,
+            "cold_start_ratio": (
+                round((seen - pending) / seen, 6) if seen else 1.0
+            ),
+            "token": list(self.token),
+            "shadow_samples": shadow_n,
+            "shadow_recall": (
+                round(ratio, 6) if ratio is not None else None
+            ),
+        }

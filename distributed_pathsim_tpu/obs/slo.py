@@ -87,13 +87,18 @@ def default_specs(
     staleness_p99_s: float = 5.0,
     availability: float = 0.999,
     recall_floor: float = 0.98,
+    cold_start_floor: float = 0.95,
     windows: tuple = DEFAULT_WINDOWS,
 ) -> tuple[SLOSpec, ...]:
     """The shipped fleet objectives — every one reads a metric this
     repo already emits, so the engine works on day one with no config:
     availability and p99 latency over the router's request stream,
-    update-visible-by staleness over the delta path, and the ann
-    score-recall floor (worst replica)."""
+    update-visible-by staleness over the delta path, the ann
+    score-recall floor (worst replica), and the learned tier's
+    cold-start answerability floor (fraction of appended rows already
+    absorbed into the towers, worst replica — a replica falling behind
+    on absorbs is answering its cold-start authors through counted
+    fallbacks instead of the learned arm)."""
     return (
         SLOSpec(
             name="availability", kind="availability",
@@ -115,6 +120,12 @@ def default_specs(
             name="ann_recall", kind="gauge_floor",
             metric="dpathsim_ann_recall_ratio",
             objective=0.99, threshold=recall_floor, windows=windows,
+        ),
+        SLOSpec(
+            name="cold_start_answerable", kind="gauge_floor",
+            metric="dpathsim_learned_cold_start_ratio",
+            objective=0.99, threshold=cold_start_floor,
+            windows=windows,
         ),
     )
 

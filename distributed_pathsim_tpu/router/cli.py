@@ -174,6 +174,14 @@ def _build_worker_service(args):
         ann_variant=args.ann_variant,
         ann_shadow_every=args.ann_shadow_every,
         ann_auto_refresh=not args.no_ann_refresh,
+        learned_checkpoint=args.learned_checkpoint,
+        learned_dim=args.learned_dim,
+        learned_steps=args.learned_steps,
+        learned_neg_ratio=args.learned_neg_ratio,
+        learned_cand_mult=args.learned_cand_mult,
+        learned_shadow_every=args.learned_shadow_every,
+        learned_recall_floor=args.learned_recall_floor,
+        learned_auto_refresh=not args.no_learned_refresh,
         memo_budget_mb=args.memo_budget_mb,
         max_metapaths=args.max_metapaths,
         compact_auto=not args.no_compact,
@@ -285,13 +293,16 @@ _FORWARD_VALUE = (
     "cache_entries", "tile_cache_mb", "headroom", "delta_threshold",
     "tuning_table", "topk_mode", "index", "ann_nprobe", "ann_cand_mult",
     "ann_centroids", "ann_cluster_cap", "ann_variant",
-    "ann_shadow_every", "metrics_interval", "trace_sample",
+    "ann_shadow_every", "learned_checkpoint", "learned_dim",
+    "learned_steps", "learned_neg_ratio", "learned_cand_mult",
+    "learned_shadow_every", "learned_recall_floor",
+    "metrics_interval", "trace_sample",
     "factor_format", "compact_chain_len", "compact_headroom_frac",
     "compact_headroom", "compact_cooldown",
 )
 _FORWARD_TRUE = (
     "no_warm", "no_metrics", "no_tuning", "approx", "no_ann_refresh",
-    "no_compact",
+    "no_learned_refresh", "no_compact",
 )
 # artifact-path flags forwarded with a per-worker suffix: a fleet run
 # with --metrics-file/--trace-out/--metrics must leave N+1 artifacts

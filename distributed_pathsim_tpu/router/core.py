@@ -1774,6 +1774,12 @@ class Router:
                             # queries never NEED one — an ann request on
                             # an index-less replica answers exactly
                             "index": w.last_health.get("index"),
+                            # per-mode index-epoch map (generalizes
+                            # the ANN-only key above): exact / ann /
+                            # learned, each with its own epoch — a
+                            # learned request re-dispatched onto a
+                            # tower-less replica still answers, exactly
+                            "modes": w.last_health.get("modes"),
                         }
                         for w in self.workers.values()
                     },

@@ -203,7 +203,7 @@ class WorkerRuntime:
             return
         k = int(req.get("k") or self.service.config.k_default)
         mode = req.get("mode")
-        if mode not in (None, "exact", "ann"):
+        if mode not in (None, "exact", "ann", "learned"):
             fail(f"unknown topk mode {mode!r}")
             return
         t0 = time.perf_counter()
@@ -229,6 +229,12 @@ class WorkerRuntime:
             ann_fallback = self.service.ann_fallback_reason(row, mode)
         except Exception:
             ann_fallback = None
+        try:
+            learned_fallback = self.service.learned_fallback_reason(
+                row, mode
+            )
+        except Exception:
+            learned_fallback = None
         # the remote trace context (or this worker's request span)
         # becomes the submit's ambient parent: the coalescer pipeline's
         # spans land inside the fleet trace
@@ -274,6 +280,8 @@ class WorkerRuntime:
                 result = {"row": int(row), "topk": hits}
                 if ann_fallback is not None:
                     result["ann_fallback"] = ann_fallback
+                if learned_fallback is not None:
+                    result["learned_fallback"] = learned_fallback
                 resp = {
                     "id": rid,
                     "ok": True,

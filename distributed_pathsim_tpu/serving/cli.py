@@ -168,11 +168,14 @@ def build_serve_parser() -> argparse.ArgumentParser:
         help="ignore any tuning table (env included)",
     )
     p.add_argument(
-        "--topk-mode", default="exact", choices=("exact", "ann"),
+        "--topk-mode", default="exact",
+        choices=("exact", "ann", "learned"),
         help="default topk answer path: 'exact' scores the full O(N) "
         "row; 'ann' probes the MIPS candidate index and exact-reranks "
-        "C >> k candidates (per-request override via the protocol's "
-        "'mode' field; ineligible rows silently degrade to exact)",
+        "C >> k candidates; 'learned' shortlists via the two-tower "
+        "encoder and exact-reranks (per-request override via the "
+        "protocol's 'mode' field; ineligible rows silently degrade "
+        "learned -> ann -> exact, counted per reason)",
     )
     p.add_argument(
         "--index", default=None,
@@ -218,6 +221,48 @@ def build_serve_parser() -> argparse.ArgumentParser:
         help="disable the background re-embed of delta-staled index "
         "rows (they then stay on the exact path until the "
         "'refresh_index' op)",
+    )
+    p.add_argument(
+        "--learned-checkpoint", default=None,
+        help="prebuilt `dpathsim learned train` tower artifact (.npz); "
+        "must match the served graph's base fingerprint and token. "
+        "Absent with --topk-mode learned, a tower is distilled "
+        "in-process at startup",
+    )
+    p.add_argument(
+        "--learned-dim", type=int, default=None,
+        help="tower output width for the in-process distillation "
+        "(default: tuning registry)",
+    )
+    p.add_argument(
+        "--learned-steps", type=int, default=200,
+        help="distillation steps for the in-process startup training",
+    )
+    p.add_argument(
+        "--learned-neg-ratio", type=float, default=None,
+        help="uniform-negative fraction of in-process training slates "
+        "(default: tuning registry)",
+    )
+    p.add_argument(
+        "--learned-cand-mult", type=int, default=None,
+        help="candidates per learned query as a multiple of k "
+        "(default: tuning registry)",
+    )
+    p.add_argument(
+        "--learned-shadow-every", type=int, default=64,
+        help="every Nth learned dispatch also runs the exact oracle "
+        "and feeds the recall-confidence gate (0 disables shadowing)",
+    )
+    p.add_argument(
+        "--learned-recall-floor", type=float, default=None,
+        help="shadow score-recall floor below which the learned arm "
+        "disables itself (default: tuning registry)",
+    )
+    p.add_argument(
+        "--no-learned-refresh", action="store_true",
+        help="disable the background tower re-embed after deltas "
+        "(stale/appended rows then degrade until the "
+        "'refresh_towers' op)",
     )
     return p
 
@@ -278,6 +323,14 @@ def serve_main(argv: list[str] | None = None) -> int:
         ann_variant=args.ann_variant,
         ann_shadow_every=args.ann_shadow_every,
         ann_auto_refresh=not args.no_ann_refresh,
+        learned_checkpoint=args.learned_checkpoint,
+        learned_dim=args.learned_dim,
+        learned_steps=args.learned_steps,
+        learned_neg_ratio=args.learned_neg_ratio,
+        learned_cand_mult=args.learned_cand_mult,
+        learned_shadow_every=args.learned_shadow_every,
+        learned_recall_floor=args.learned_recall_floor,
+        learned_auto_refresh=not args.no_learned_refresh,
         memo_budget_mb=args.memo_budget_mb,
         max_metapaths=args.max_metapaths,
         compact_auto=not args.no_compact,

@@ -122,7 +122,8 @@ _QUERY_KEYS = ("source", "source_id", "row")
 # being able to correlate its responses.
 PROTOCOL_OPS = frozenset({
     "ping", "stats", "metrics", "health", "invalidate", "topk",
-    "refresh_index", "update", "scores", "trace", "compact",
+    "refresh_index", "refresh_towers", "update", "scores", "trace",
+    "compact",
     # partition-mode exchange ops (DESIGN.md §26): served by
     # PartitionService workers behind `dpathsim router --mode
     # partition`; on a replica service they fail as clean per-request
@@ -243,6 +244,11 @@ def _dispatch_op(
         }
     if op == "refresh_index":
         return service.refresh_index()
+    if op == "refresh_towers":
+        # absorb the patched graph into the learned tier (re-embed
+        # stale + appended rows in place); idempotent — re-running
+        # re-absorbs an already-current snapshot as a no-op
+        return service.refresh_towers()
     if op == "compact":
         # force one background-style compaction synchronously
         # (serving/compact.py): re-encode with fresh pow-2 headroom,

@@ -120,6 +120,32 @@ class ServeConfig:
     # patch update (stale rows answer exactly in the meantime either
     # way); off = refresh only via the refresh_index op/method.
     ann_auto_refresh: bool = True
+    # -- learned candidate generation (learned/ subsystem, §32) --------
+    # "learned" topk_mode: trained two-tower candidates, exact-f64
+    # reranked — same never-wrong contract as ann, plus cold-start
+    # answering (appended rows embed inductively, no full re-embed).
+    # A `dpathsim learned train` artifact; None + mode "learned"
+    # distills a tower in-process at startup (exact-teacher mining).
+    learned_checkpoint: str | None = None
+    # In-process training geometry (None resolves the tuned
+    # learned_dim / learned_neg_ratio knobs); steps stay small — the
+    # exact rerank carries correctness, the tower only needs recall.
+    learned_dim: int | None = None
+    learned_steps: int = 200
+    learned_neg_ratio: float | None = None
+    learned_cand_mult: int | None = None
+    # Shadow-recall confidence gate (the ann gate's twin): every Nth
+    # learned dispatch also runs the exact oracle; measured
+    # score-recall below the floor disables the learned arm until a
+    # refresh. None floor → the tuned learned_conf_floor knob.
+    learned_shadow_every: int = 64
+    learned_recall_floor: float | None = None
+    learned_min_shadow: int = 8
+    # Re-embed delta-staled/appended rows in a background thread after
+    # patch updates (stale/cold rows answer by counted fallback in the
+    # meantime); cadence (every Nth delta) is the tuned
+    # learned_refresh_deltas knob.
+    learned_auto_refresh: bool = True
     # -- multi-metapath workload (ops/planner.py, DESIGN.md §28) -------
     # Sub-chain memo budget shared by every metapath engine (None →
     # the tuned ``plan_memo_budget_mb`` knob; 0 disables memoization).
@@ -193,7 +219,9 @@ class PathSimService:
                 "dpathsim_serve_request_seconds",
                 "submit-to-resolve request latency by outcome",
             ).labels(outcome=outcome)
-            for outcome in ("hit_result", "hit_tile", "dispatch", "ann")
+            for outcome in (
+                "hit_result", "hit_tile", "dispatch", "ann", "learned"
+            )
         }
         self._m_updates = reg.counter(
             "dpathsim_serve_updates_total",
@@ -204,13 +232,17 @@ class PathSimService:
         from ..utils.xla_flags import install_compile_metrics
 
         install_compile_metrics()
-        if self.config.topk_mode not in ("exact", "ann"):
+        if self.config.topk_mode not in ("exact", "ann", "learned"):
             raise ValueError(
                 f"unknown topk_mode {self.config.topk_mode!r}; "
-                "choose 'exact' or 'ann'"
+                "choose 'exact', 'ann' or 'learned'"
             )
         self._ann = None  # AnnState once _setup_ann builds/loads one
         self._ann_refresh_inflight = False  # background-refresh debounce
+        self._learned = None  # LearnedState once _setup_learned runs
+        self._learned_refresh_inflight = False
+        self._learned_deltas = 0  # deltas since the last tower refresh
+        self._learned_refresh_every = 1  # tuned cadence (re-set at setup)
         # Workload-level sub-chain memo + lazily-built per-metapath
         # engines (per-request ``metapath`` field). Built BEFORE the
         # backend install so a rebuild-time engine flush finds them.
@@ -336,6 +368,7 @@ class PathSimService:
                 variant=self.variant,
             )
         self._setup_ann(warm=warm)
+        self._setup_learned()
 
     def _setup_ann(self, warm: bool) -> None:
         """(Re)build or load the ANN candidate index for the freshly
@@ -446,6 +479,130 @@ class PathSimService:
             nprobe=self._ann.nprobe, cand_mult=self._ann.cand_mult,
             variant=self._ann.variant,
             source="file" if cfg.index_path else "built",
+            startup_s=round(time.perf_counter() - t0, 3),
+        )
+
+    def _setup_learned(self) -> None:
+        """(Re)build or load the learned-tower candidate state for the
+        freshly installed backend (DESIGN.md §32). The ann discipline:
+        every defect degrades to ann/exact serving with a loud event,
+        never a crash. In-process training (no checkpoint) pays its
+        jit compiles HERE, at install time — the query path afterwards
+        is pure host numpy, so steady state compiles nothing."""
+        cfg = self.config
+        if self._learned is not None:
+            self._learned.close()
+        self._learned = None
+        if cfg.topk_mode != "learned" and cfg.learned_checkpoint is None:
+            return
+        from .. import tuning
+        from ..index.build import half_chain_and_denominators
+        from ..learned import (
+            LearnedState, TowerMismatch, load_towers, train_towers,
+        )
+
+        t0 = time.perf_counter()
+        try:
+            c, d = half_chain_and_denominators(
+                self.hin, self.metapath, self.variant
+            )
+        except (ValueError, MemoryError) as exc:
+            runtime_event("learned_unavailable", reason=str(exc))
+            return
+        encoder = token = None
+        source = "file"
+        if cfg.learned_checkpoint is not None:
+            try:
+                encoder, token = load_towers(
+                    cfg.learned_checkpoint, expect_base_fp=self._base_fp
+                )
+            except (TowerMismatch, OSError, KeyError, ValueError) as exc:
+                runtime_event(
+                    "learned_towers_rejected",
+                    path=cfg.learned_checkpoint, reason=str(exc),
+                )
+            if encoder is not None and tuple(token) != self.consistency_token:
+                # an artifact trained mid-delta-stream: its towers may
+                # lag this replica's graph — refuse rather than serve
+                # candidates from an unverifiable epoch
+                runtime_event(
+                    "learned_towers_rejected",
+                    path=cfg.learned_checkpoint,
+                    reason=f"towers token {list(token)} != service "
+                    f"token {self.consistency_token}",
+                )
+                encoder = None
+            if encoder is not None:
+                for axis, want in (("variant", self.variant),
+                                   ("metapath", self.metapath.name)):
+                    got = getattr(encoder, axis)
+                    if got != want:
+                        runtime_event(
+                            "learned_towers_rejected",
+                            path=cfg.learned_checkpoint,
+                            reason=f"towers {axis} {got!r} != served "
+                            f"{want!r}",
+                        )
+                        encoder = None
+                        break
+            if encoder is None and cfg.topk_mode != "learned":
+                # the learned arm was optional here — degrade quietly
+                return
+        if encoder is None:
+            # no checkpoint, or a rejected one on a learned-mode
+            # service: distill in-process (the rejection already
+            # shouted; a learned-mode replica must still come up
+            # serving learned, not limp along exact-only)
+            dim = cfg.learned_dim or int(tuning.choose(
+                "learned_dim", n=self.n, default=32
+            ))
+            neg_ratio = (
+                cfg.learned_neg_ratio
+                if cfg.learned_neg_ratio is not None
+                else float(tuning.choose(
+                    "learned_neg_ratio", n=self.n, default=0.5
+                ))
+            )
+            try:
+                encoder, _ = train_towers(
+                    self.hin, self.metapath, variant=self.variant,
+                    dim=dim, steps=cfg.learned_steps,
+                    hard_frac=1.0 - neg_ratio,
+                    hard_sources=min(self.n, 512),
+                    token=self.consistency_token,
+                )
+            except (ValueError, MemoryError) as exc:
+                runtime_event("learned_unavailable", reason=str(exc))
+                return
+            token = self.consistency_token
+            source = "trained"
+        cand_mult = cfg.learned_cand_mult or int(tuning.choose(
+            "learned_cand_mult", n=self.n, default=16
+        ))
+        recall_floor = (
+            cfg.learned_recall_floor
+            if cfg.learned_recall_floor is not None
+            else float(tuning.choose(
+                "learned_conf_floor", n=self.n, default=0.98
+            ))
+        )
+        self._learned = LearnedState(
+            encoder, c, d,
+            cand_mult=cand_mult,
+            shadow_every=cfg.learned_shadow_every,
+            recall_floor=recall_floor,
+            min_shadow=cfg.learned_min_shadow,
+            token=token,
+        )
+        self._learned_deltas = 0
+        self._learned_refresh_every = max(int(tuning.choose(
+            "learned_refresh_deltas", n=self.n, default=1
+        )), 1)
+        runtime_event(
+            "learned_ready",
+            n=self._learned.n, dim=encoder.dim, hidden=encoder.hidden,
+            cand_mult=cand_mult, recall_floor=recall_floor,
+            source=source,
             startup_s=round(time.perf_counter() - t0, 3),
         )
 
@@ -570,6 +727,14 @@ class PathSimService:
         ``ann`` lane issues the index probe instead — one batched
         matmul over the packed cluster blocks, same async-handle
         contract."""
+        if lane == "learned":
+            # tower probe: one host matmul over the f32 embeddings —
+            # no device round-trip, no compile, returns the sealed
+            # handle the completion half reranks from
+            t0 = time.perf_counter()
+            handle = self._learned.probe_batch(rows_padded)
+            self._learned.observe_probe(time.perf_counter() - t0)
+            return handle
         if lane == "ann":
             if self._ann.variant == "rerank-all":
                 if self._ann.route_on_host:
@@ -667,6 +832,52 @@ class PathSimService:
                 )
                 ann.record_shadow(vals, evals, k_eff)
 
+    def _complete_learned(
+        self, handle, rows: np.ndarray, batch: Sequence[Request]
+    ) -> None:
+        """Completion half of a ``learned`` batch: exact-f64 rerank the
+        tower shortlist for each request INSIDE learned/ (the LN001
+        doorway — this method never reads the handle's raw
+        similarities), fill the learned result-cache tier, resolve
+        futures. Every Nth dispatch also runs the exact oracle (shadow
+        sampling) to keep the recall-confidence gate honest — deferred
+        past future resolution like the ANN path, because an O(N)
+        oracle scan must never sit in front of a waiting caller."""
+        tracer = get_tracer()
+        lr = self._learned
+
+        def _rerank_one(b: int):
+            row = int(rows[b])
+            k_eff = min(batch[b].k, max(self.n - 1, 1))
+            t1 = time.perf_counter()
+            vals, idxs = lr.answer_from_handle(handle, b, row, k_eff)
+            lr.observe_rerank(time.perf_counter() - t1)
+            return k_eff, vals, idxs
+
+        with tracer.child_span("serve.learned_rerank", n=len(batch)):
+            reranked = list(lr.pool.map(_rerank_one, range(len(batch))))
+            shadows = []
+            for b, req in enumerate(batch):
+                row = int(rows[b])
+                k_eff, vals, idxs = reranked[b]
+                lr.count_answered()
+                if lr.should_shadow():
+                    shadows.append((row, k_eff, vals))
+                self.result_cache.put(
+                    self._learned_key(row, req.k), vals, idxs
+                )
+                if not req.future.done():
+                    req.future.set_result((vals, idxs))
+                self._m_latency["learned"].observe(
+                    time.monotonic() - (req.t_submit or req.t_enqueue)
+                )
+                tracer.finish(req.span, outcome="learned")
+            for row, k_eff, vals in shadows:  # every future resolved
+                evals, _ = self.backend.topk_row(
+                    row, k=k_eff, variant=self.variant
+                )
+                lr.record_shadow(vals, evals, k_eff)
+
     def _complete(
         self,
         handle,
@@ -680,6 +891,8 @@ class PathSimService:
         cache tiers, resolve futures. The tracer spans opened here
         parent into the batch's ``serve.complete`` span — the coalescer
         activated its context on this thread before calling."""
+        if lane == "learned":
+            return self._complete_learned(handle, rows, batch)
         if lane == "ann":
             return self._complete_ann(handle, rows, batch)
         if lane.startswith(_MP_LANE):
@@ -817,9 +1030,10 @@ class PathSimService:
         """Per-request mode override → effective answer path."""
         if mode is None:
             mode = self.config.topk_mode
-        if mode not in ("exact", "ann"):
+        if mode not in ("exact", "ann", "learned"):
             raise ValueError(
-                f"unknown topk mode {mode!r}; choose 'exact' or 'ann'"
+                f"unknown topk mode {mode!r}; choose 'exact', 'ann' or "
+                "'learned'"
             )
         return mode
 
@@ -837,6 +1051,30 @@ class PathSimService:
         if self._ann is None:
             return "no_index"
         return self._ann.peek(int(row))
+
+    def learned_fallback_reason(self, row: int,
+                                mode: str | None = None) -> str | None:
+        """Would a learned-mode query for ``row`` degrade right now,
+        and why? Side-effect-free peek (no counters), mirror of
+        :meth:`ann_fallback_reason` — the worker annotates responses
+        with it so the router's flight recorder can tail-keep
+        learned-degraded requests. None = the learned path answers (or
+        the effective mode isn't learned)."""
+        if self._resolve_mode(mode) != "learned":
+            return None
+        if self._learned is None:
+            return "no_towers"
+        return self._learned.peek(int(row))
+
+    def _learned_key(self, row: int, k: int) -> tuple:
+        """Learned result-cache key: the exact epoch prefix plus a
+        ``learned`` axis and the knobs that shape the candidate set —
+        a learned answer can never be served to an exact or ann query
+        (and vice versa), and retuning cand_mult can't replay old
+        shortlists."""
+        lr = self._learned
+        return (*self._epoch_for(row), "learned", lr.encoder.dim,
+                lr.cand_mult, int(row), int(k))
 
     def _ann_key(self, row: int, k: int) -> tuple:
         """ANN result-cache key: the exact path's epoch prefix (base
@@ -885,6 +1123,14 @@ class PathSimService:
                             "dpathsim_ann_fallbacks_total",
                             "ann-requested queries answered exactly "
                             "instead, by reason",
+                        ).inc(reason="metapath")
+                    elif mode == "learned":
+                        # same story for the towers: they were
+                        # distilled against the default chain only
+                        get_registry().counter(
+                            "dpathsim_learned_fallbacks_total",
+                            "learned-requested queries degraded to "
+                            "ann/exact, by reason",
                         ).inc(reason="metapath")
                     return self._submit_metapath_locked(
                         int(row), k, name, root, t0
@@ -935,6 +1181,33 @@ class PathSimService:
         # drain would never finish, and a request could resolve rows
         # against one graph and dispatch against another).
         tracer = get_tracer()
+        if mode == "learned":
+            if self._learned is None:
+                get_registry().counter(
+                    "dpathsim_learned_fallbacks_total",
+                    "learned-requested queries degraded to ann/exact, "
+                    "by reason",
+                ).inc(reason="no_towers")
+            elif self._learned.eligible(row) is None:
+                key = self._learned_key(row, k)
+                hit = self.result_cache.get(key)
+                if hit is not None:
+                    fut: Future = Future()
+                    fut.set_result(hit)
+                    self._m_latency["hit_result"].observe(
+                        time.monotonic() - t0
+                    )
+                    tracer.finish(root, outcome="hit_result")
+                    return fut
+                return self.coalescer.submit(
+                    int(row), k, span=root, t_submit=t0, lane="learned"
+                )
+            # ineligible (counted by reason): degrade ANN-then-exact —
+            # the ann cascade below re-checks its own eligibility and
+            # counts its own fallbacks, so a doubly-degraded query
+            # lands on exact with both arms' accounting intact
+            if self._ann is not None:
+                mode = "ann"
         if mode == "ann":
             if self._ann is None:
                 get_registry().counter(
@@ -1201,6 +1474,37 @@ class PathSimService:
                 if self._ann is not None
                 else None
             ),
+            # per-mode index-epoch map (generalizes the ANN-only
+            # "index" key above, which stays for back-compat): one
+            # entry per answer path this replica can serve, each with
+            # its own consistency epoch — a router re-dispatching a
+            # learned query onto a tower-less replica reads this, and
+            # the fallback story guarantees the answer is exact either
+            # way
+            "modes": {
+                "exact": {
+                    "epoch": [self._base_fp, self._delta_seq],
+                    "stale_rows": 0,
+                    "enabled": True,
+                },
+                "ann": (
+                    {
+                        "epoch": list(self._ann.index.token),
+                        "stale_rows": self._ann.index.stale_count,
+                        "enabled": self._ann.enabled,
+                    }
+                    if self._ann is not None else None
+                ),
+                "learned": (
+                    {
+                        "epoch": list(self._learned.token),
+                        "stale_rows": self._learned.stale_count,
+                        "pending_appends": self._learned.pending_appends,
+                        "enabled": self._learned.enabled,
+                    }
+                    if self._learned is not None else None
+                ),
+            },
             # process-lifetime XLA compile count: a steady-state worker
             # whose number moves is violating the shape-bucket contract
             # (the router smoke's zero-recompile gate reads this)
@@ -1293,6 +1597,17 @@ class PathSimService:
                     # (background) refresh re-embeds them. Appended
                     # rows are uncovered by construction.
                     self._ann.index.mark_stale(affected)
+                if self._learned is not None:
+                    # same fence for the towers: affected rows answer
+                    # exactly until absorb() re-embeds them; appended
+                    # source rows (headroom slots made real) go
+                    # cold-start pending (the SLO gauge tracks them)
+                    self._learned.mark_stale(affected)
+                    self._learned.note_appends(sum(
+                        a.n for a in plan.delta.nodes
+                        if a.node_type == self.node_type
+                    ))
+                    self._learned_deltas += 1
                 if want_rows:
                     # the router's fencing machinery needs the SET, not
                     # the count: a replica that missed this delta is
@@ -1378,6 +1693,39 @@ class PathSimService:
                         target=self._refresh_index_quietly,
                         args=(link,),
                         name="pathsim-ann-refresh", daemon=True,
+                    ).start()
+            if self._learned is not None:
+                result["learned_stale_rows"] = self._learned.stale_count
+                result["learned_pending_appends"] = (
+                    self._learned.pending_appends
+                )
+                if (
+                    mode == "delta"
+                    and self.config.learned_auto_refresh
+                    and (
+                        self._learned.stale_count
+                        or self._learned.pending_appends
+                    )
+                    # cadence knob: a sustained delta stream re-embeds
+                    # every Nth landing, not every landing (the fold is
+                    # the expensive input; staled rows answer exactly
+                    # in the meantime, so batching refreshes costs
+                    # speed only, never correctness)
+                    and self._learned_deltas >= self._learned_refresh_every
+                    # debounced like the ann refresh: one in flight
+                    and not self._learned_refresh_inflight
+                ):
+                    cur = get_tracer().current()
+                    link = (
+                        f"{cur.trace_id}:{cur.span_id}"
+                        if cur is not None and cur.span_id else None
+                    )
+                    self._learned_refresh_inflight = True
+                    self._learned_deltas = 0
+                    threading.Thread(
+                        target=self._refresh_towers_quietly,
+                        args=(link,),
+                        name="pathsim-learned-refresh", daemon=True,
                     ).start()
             return result
 
@@ -1509,6 +1857,99 @@ class PathSimService:
                 "ms": ms,
             }
             runtime_event("ann_refresh", **result)
+            return result
+
+    def _refresh_towers_quietly(self, link: str | None = None) -> None:
+        try:
+            with get_tracer().span("learned.refresh", link=link):
+                while True:
+                    # abandoned attempts (a newer delta landed mid-
+                    # fold) retry against the newer token — the newer
+                    # update saw inflight=True and skipped scheduling,
+                    # so its staleness is ours to absorb
+                    result = self.refresh_towers()
+                    if result.get("abandoned"):
+                        continue
+                    with self._swap_lock:
+                        lr = self._learned
+                        more = (
+                            lr is not None
+                            and (lr.stale_count or lr.pending_appends)
+                            and result.get("refreshed", 0) > 0
+                        )
+                        if not more:
+                            self._learned_refresh_inflight = False
+                            return
+        except Exception as exc:  # background thread: report, never die
+            runtime_event("learned_refresh_failed", error=repr(exc))
+            with self._swap_lock:
+                self._learned_refresh_inflight = False
+
+    def refresh_towers(self) -> dict:
+        """Absorb the patched graph into the learned tier: swap in the
+        current C/d snapshot and re-embed exactly the stale + appended
+        rows through the inductive encoder — O(Δ) tower work, zero XLA
+        compiles, the cold-start path that makes a never-seen appended
+        author answerable in learned mode before any full re-embed
+        (DESIGN.md §32). Mirrors :meth:`refresh_index`'s locking: the
+        expensive half-chain fold runs OUTSIDE the swap lock against a
+        token snapshot, the absorb applies under the lock with the
+        pipeline drained only if no further delta landed meanwhile.
+        A contraction-width change (new venue vocabulary moved the
+        feature space) is reported, not raised — affected service
+        keeps degrading those rows, correctly, until retrained."""
+        from ..index.build import half_chain_and_denominators
+
+        t0 = time.perf_counter()
+        with self._swap_lock:
+            lr = self._learned
+            if lr is None:
+                return {"learned": False, "refreshed": 0}
+            token0 = self.consistency_token
+            hin = self.hin
+            stale_n = lr.stale_count
+            pending = lr.pending_appends
+        tracer = get_tracer()
+        with tracer.child_span(
+            "learned.half_chain_fold", stale=stale_n, appends=pending
+        ):
+            c, d = half_chain_and_denominators(
+                hin, self.metapath, self.variant
+            )
+        with self._swap_lock:
+            if self._learned is not lr or self.consistency_token != token0:
+                runtime_event(
+                    "learned_refresh_abandoned", token=list(token0),
+                    reason="newer delta landed during the fold",
+                )
+                return {"learned": True, "refreshed": 0, "abandoned": True}
+            # drained like update(): the probe reads the embedding
+            # array absorb swaps, and a batch must never straddle it
+            self.coalescer.drain()
+            try:
+                with tracer.child_span("learned.absorb"):
+                    acct = lr.absorb(c, d, token0)
+            except ValueError as exc:
+                result = {
+                    "learned": True, "refreshed": 0,
+                    "stale_remaining": lr.stale_count,
+                    "unsupported": str(exc),
+                }
+                runtime_event("learned_refresh_unavailable", **result)
+                return result
+            # old shadow evidence described the pre-absorb towers
+            lr.reset_confidence()
+            ms = round((time.perf_counter() - t0) * 1e3, 3)
+            result = {
+                "learned": True,
+                "refreshed": acct["re_embedded"],
+                "appended": acct["appended"],
+                "stale_remaining": lr.stale_count,
+                "pending_appends": lr.pending_appends,
+                "token": list(lr.token),
+                "ms": ms,
+            }
+            runtime_event("learned_refresh", **result)
             return result
 
     def reload(self, backend: PathSimBackend) -> None:
@@ -1686,6 +2127,10 @@ class PathSimService:
             "factor": self.backend.factor_info(),
             "topk_mode": self.config.topk_mode,
             "ann": self._ann.snapshot() if self._ann is not None else None,
+            "learned": (
+                self._learned.snapshot()
+                if self._learned is not None else None
+            ),
             "delta": {
                 "seq": self._delta_seq,
                 "base_fingerprint": self._base_fp,
@@ -1723,6 +2168,8 @@ class PathSimService:
         self.coalescer.close()
         if self._ann is not None:
             self._ann.close()
+        if self._learned is not None:
+            self._learned.close()
 
 
 def build_service(

@@ -826,6 +826,220 @@ _ANN_KNOBS = ("ann_nprobe", "ann_cand_mult", "ann_centroids",
               "ann_cluster_cap", "ann_probe_variant")
 
 
+def bench_learned(point: SweepPoint, reps: int, k: int = 10,
+                  recall_floor: float = 0.95) -> dict:
+    """Learned-tier knobs (learned/ subsystem), measured with the same
+    RECALL GATE discipline as :func:`bench_ann`: an arm whose tower
+    shortlist misses the floor is excluded outright. Tower arms
+    (``learned_dim``, ``learned_neg_ratio``) each distill a real tower
+    per arm (tiny step budget — the race is about geometry, not final
+    loss); ``learned_cand_mult`` re-serves one tower at different
+    shortlist widths. ``learned_conf_floor`` picks the tightest floor
+    the measured recall actually clears, and
+    ``learned_refresh_deltas`` races a sustained delta+query stream
+    end to end per cadence (the bench_compaction pattern: fold cost vs
+    degraded-query cost, measured, not modeled)."""
+    from ..data.synthetic import synthetic_hin
+    from ..index.build import half_chain_and_denominators
+    from ..learned.serving import LearnedState
+    from ..learned.trainer import train_towers
+    from ..ops import pathsim
+    from ..ops.metapath import compile_metapath
+
+    # cap the training graph: tower geometry trades are visible at 2k
+    # rows, and per-arm distillation cost must stay offline-tolerable
+    n = min(point.n, 2048)
+    hin = synthetic_hin(n, 2 * n, 24, seed=0)
+    mp = compile_metapath("APVPA", hin.schema)
+    c, d = half_chain_and_denominators(hin, mp)
+    rng = np.random.default_rng(0)
+    eligible = np.flatnonzero(d > 0)
+    if eligible.size < 2:
+        return {}
+    sample = np.sort(rng.choice(
+        eligible, size=min(64, eligible.size), replace=False
+    ))
+    oracle_kth: dict[int, float] = {}
+    for row in sample:
+        scores = pathsim.score_row(c @ c[row], d[row], d)
+        scores[int(row)] = -np.inf
+        vals, _ = pathsim.topk_from_score_rows(scores[None, :], k)
+        oracle_kth[int(row)] = float(vals[0][-1])
+    qrows = rng.choice(eligible, size=(8, 32))
+
+    encoders: dict[tuple, object] = {}
+
+    def encoder_for(dim: int, neg_ratio: float):
+        key = (dim, neg_ratio)
+        if key not in encoders:
+            enc, _ = train_towers(
+                hin, "APVPA", dim=dim, hidden=64, steps=80, seed=0,
+                hard_frac=1.0 - neg_ratio,
+                hard_sources=min(n, 256), hard_k=2 * k,
+            )
+            encoders[key] = enc
+        return encoders[key]
+
+    states: list[LearnedState] = []
+
+    def state_for(dim: int, neg_ratio: float,
+                  cand_mult: int) -> LearnedState:
+        st = LearnedState(
+            encoder_for(dim, neg_ratio), c, d,
+            cand_mult=cand_mult, shadow_every=0,
+        )
+        states.append(st)
+        return st
+
+    def recall_of(st: LearnedState) -> float:
+        hits = tot = 0
+        handle = st.probe_batch(sample.astype(np.int64))
+        for b, row in enumerate(sample):
+            vals, _ = st.answer_from_handle(handle, b, int(row), k)
+            kth = oracle_kth[int(row)]
+            got = vals[np.isfinite(vals)]
+            hits += min(int((got >= kth).sum()), k)
+            tot += k
+        return hits / max(tot, 1)
+
+    def timing_arm(st: LearnedState):
+        def run():
+            for batch in qrows:
+                handle = st.probe_batch(batch)
+                for b, row in enumerate(batch):
+                    st.answer_from_handle(handle, b, int(row), k)
+
+        return run
+
+    def race(named_states: dict) -> tuple | None:
+        arms, recalls = {}, {}
+        for name, st in named_states.items():
+            r = recall_of(st)
+            recalls[name] = r
+            if r >= recall_floor:
+                arms[name] = timing_arm(st)
+        if not arms:
+            return None
+        res = br.time_interleaved(arms, reps)
+        for name in res:
+            res[name]["recall"] = round(recalls[name], 4)
+        return br.best_arm(res), res
+
+    out: dict = {}
+    try:
+        dim_w, neg_w, mult_w = 32, 0.5, 16
+        raced = race({
+            f"dim{dm}": state_for(dm, neg_w, mult_w)
+            for dm in KNOBS["learned_dim"].candidates({"n": n})
+        })
+        if raced is not None:
+            win, res = raced
+            dim_w = int(win.removeprefix("dim"))
+            out["learned_dim"] = (dim_w, res)
+
+        raced = race({
+            f"neg{nr}": state_for(dim_w, nr, mult_w)
+            for nr in KNOBS["learned_neg_ratio"].candidates({"n": n})
+        })
+        if raced is not None:
+            win, res = raced
+            neg_w = float(win.removeprefix("neg"))
+            out["learned_neg_ratio"] = (neg_w, res)
+
+        raced = race({
+            f"mult{m}": state_for(dim_w, neg_w, m)
+            for m in KNOBS["learned_cand_mult"].candidates({"n": n})
+        })
+        if raced is not None:
+            win, res = raced
+            mult_w = int(win.removeprefix("mult"))
+            out["learned_cand_mult"] = (mult_w, res)
+
+        # confidence floor: the tightest (highest) candidate floor the
+        # measured recall of the SHIPPED configuration clears — a floor
+        # above measured recall would trip the gate on day one, a floor
+        # far below it wastes the safety margin the gate exists for
+        final = state_for(dim_w, neg_w, mult_w)
+        r_final = recall_of(final)
+        floors = KNOBS["learned_conf_floor"].candidates({"n": n})
+        feasible = [f for f in floors if f <= r_final]
+        if feasible:
+            ms = br.time_interleaved(
+                {"final": timing_arm(final)}, reps
+            )["final"]["median_of_best_ms"]
+            res = {
+                f"floor{f}": {
+                    "median_of_best_ms": ms,
+                    "recall": round(r_final, 4),
+                }
+                for f in floors
+                if f <= r_final
+            }
+            out["learned_conf_floor"] = (max(feasible), res)
+
+        # refresh cadence: a sustained delta+query stream, end to end
+        # per arm — each "delta" stales a row block (those queries
+        # answer through the exact path, the serving fallback), every
+        # cadence-th landing pays the real half-chain fold + absorb
+        enc_final = encoder_for(dim_w, neg_w)
+        stale_blocks = rng.choice(
+            eligible, size=(8, 32)).astype(np.int64)
+
+        def cadence_arm(every: int):
+            def run():
+                st = LearnedState(
+                    enc_final, c, d, cand_mult=mult_w, shadow_every=0
+                )
+                states.append(st)
+                since = 0
+                for i, block in enumerate(stale_blocks):
+                    st.mark_stale(block)
+                    since += 1
+                    for b, row in enumerate(qrows[i % len(qrows)]):
+                        row = int(row)
+                        if st.peek(row) is not None:
+                            scores = pathsim.score_row(
+                                c @ c[row], d[row], d
+                            )
+                            scores[row] = -np.inf
+                            pathsim.topk_from_score_rows(
+                                scores[None, :], k
+                            )
+                        else:
+                            h = st.probe_batch(
+                                np.asarray([row], dtype=np.int64)
+                            )
+                            st.answer_from_handle(h, 0, row, k)
+                    if since >= every:
+                        c2, d2 = half_chain_and_denominators(hin, mp)
+                        st.absorb(c2, d2, ("", i + 1))
+                        since = 0
+
+            return run
+
+        res = br.time_interleaved(
+            {
+                f"every{e}": cadence_arm(e)
+                for e in KNOBS["learned_refresh_deltas"]
+                .candidates({"n": n})
+            },
+            reps,
+        )
+        win = br.best_arm(res)
+        out["learned_refresh_deltas"] = (
+            int(win.removeprefix("every")), res
+        )
+    finally:
+        for st in states:
+            st.close()
+    return out
+
+
+_LEARNED_KNOBS = ("learned_dim", "learned_neg_ratio",
+                  "learned_cand_mult", "learned_conf_floor",
+                  "learned_refresh_deltas")
+
+
 # ---------------------------------------------------------------------------
 # Sweep driver
 # ---------------------------------------------------------------------------
@@ -901,6 +1115,8 @@ def tune(
                 record(point, bench_ring(point, reps))
             if want & set(_ANN_KNOBS):
                 record(point, bench_ann(point, reps))
+            if want & set(_LEARNED_KNOBS):
+                record(point, bench_learned(point, reps))
             if want & {"plan_density_cutover", "plan_memo_budget_mb"}:
                 record(point, bench_planner(point, reps))
             if "factor_format" in want:

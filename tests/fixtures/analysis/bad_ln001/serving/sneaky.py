@@ -1,0 +1,6 @@
+"""BAD: serves raw tower similarities without the rerank (LN001)."""
+
+
+def answer_row(state, rows):
+    handle = state.probe_batch(rows)
+    return handle.raw_sims[0]

@@ -1,0 +1,18 @@
+"""Fixture: the guarded learned-score surface registry."""
+LEARNED_SURFACE = frozenset({"tower_sims", "raw_sims"})
+
+
+class ProbeHandle:
+    def __init__(self, raw_sims):
+        self.raw_sims = raw_sims
+
+
+class LearnedState:
+    def tower_sims(self, rows):
+        return [[0.0]]
+
+    def probe_batch(self, rows):
+        return ProbeHandle(self.tower_sims(rows))
+
+    def answer_from_handle(self, handle, b, row, k):
+        return [0.0], [0]

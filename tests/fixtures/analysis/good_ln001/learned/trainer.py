@@ -1,0 +1,6 @@
+"""GOOD: learned/ modules may read raw sims (to measure the towers)."""
+
+
+def val_recall(state, rows):
+    handle = state.probe_batch(rows)
+    return handle.raw_sims

@@ -1,0 +1,514 @@
+"""Inductive learned serving tier: distillation, cold start, safety.
+
+The load-bearing guarantees (ISSUE 19 / DESIGN.md §32):
+
+- the learned arm is NEVER WRONG, only slower: towers only generate
+  candidates, every answer is exact-f64 reranked through the same
+  ``score_candidates`` doorway as ann — bit-identical to the exact
+  oracle whenever the candidate set covers (and the tests pin
+  ``learned_cand_mult`` high enough that it always does);
+- a NEVER-SEEN appended author is answerable in learned mode before
+  any retrain or full re-embed: immediately through the counted
+  'stale' fallback (exact, bit-identical), and through the towers
+  proper after one O(Δ) inductive absorb (``refresh_towers``);
+- every degradation is a counted fallback
+  (``dpathsim_learned_fallbacks_total{reason}``): no_towers, stale,
+  degenerate, low_confidence, metapath — each edge exercised here;
+- checkpoints are keyed to (base fingerprint, delta seq, metapath,
+  variant): a mismatched artifact is refused loudly (TowerMismatch),
+  and the service falls back to in-process distillation;
+- the ``--emit-pairs`` JSONL contract (batch/pairs.py): schema-checked
+  load, seeded by-source train/val split, bounded negative sampling.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from distributed_pathsim_tpu.backends.base import create_backend
+from distributed_pathsim_tpu.data import delta as dl
+from distributed_pathsim_tpu.data.synthetic import synthetic_hin
+from distributed_pathsim_tpu.learned import (
+    LEARNED_FALLBACK_REASONS,
+    TowerMismatch,
+    load_towers,
+    save_towers,
+    train_towers,
+)
+from distributed_pathsim_tpu.ops.metapath import compile_metapath
+from distributed_pathsim_tpu.serving import PathSimService, ServeConfig
+
+
+@pytest.fixture(scope="module")
+def hin():
+    # headroom so deltas can append without rebuild
+    return dl.with_headroom(synthetic_hin(120, 200, 8, seed=7), 0.25)
+
+
+@pytest.fixture(scope="module")
+def metapath(hin):
+    return compile_metapath("APVPA", hin.schema)
+
+
+def _learned_service(hin, metapath, **cfg):
+    cfg.setdefault("max_wait_ms", 0.5)
+    cfg.setdefault("warm", False)
+    cfg.setdefault("topk_mode", "learned")
+    cfg.setdefault("learned_steps", 40)
+    cfg.setdefault("learned_shadow_every", 0)
+    cfg.setdefault("learned_auto_refresh", False)
+    # candidate set ≥ n on this graph: coverage is total, so every
+    # learned answer must be bit-identical — the safety property under
+    # test, independent of how good 40 training steps made the towers
+    cfg.setdefault("learned_cand_mult", 32)
+    return PathSimService(
+        create_backend("numpy", hin, metapath),
+        config=ServeConfig(**cfg),
+    )
+
+
+def _fallbacks(reason: str) -> float:
+    from distributed_pathsim_tpu.obs.metrics import get_registry
+
+    return get_registry().counter(
+        "dpathsim_learned_fallbacks_total",
+        "learned-requested queries degraded to ann/exact, by reason",
+    ).labels(reason=reason).value
+
+
+# -- the safety story: exact rerank, bit-identical answers -----------------
+
+
+def test_learned_mode_answers_bit_identically(hin, metapath):
+    svc = _learned_service(hin, metapath)
+    try:
+        assert svc.stats()["learned"] is not None
+        lr = svc._learned
+        eligible = np.flatnonzero(np.asarray(lr.d)[: svc.n] > 0)
+        for row in eligible[:: max(eligible.size // 24, 1)]:
+            lv, li = svc.topk_index(int(row), k=7, mode="learned")
+            ev, ei = svc.topk_index(int(row), k=7, mode="exact")
+            np.testing.assert_array_equal(lv, ev)
+            np.testing.assert_array_equal(li, ei)
+    finally:
+        svc.close()
+
+
+def test_degenerate_row_falls_back_exactly(hin, metapath):
+    svc = _learned_service(hin, metapath)
+    try:
+        dead = np.flatnonzero(np.asarray(svc._learned.d)[: svc.n] <= 0)
+        assert dead.size, "fixture graph needs a zero-denominator row"
+        row = int(dead[0])
+        assert svc.learned_fallback_reason(row, "learned") == "degenerate"
+        before = _fallbacks("degenerate")
+        lv, li = svc.topk_index(row, k=5, mode="learned")
+        assert _fallbacks("degenerate") > before
+        ev, ei = svc.topk_index(row, k=5, mode="exact")
+        np.testing.assert_array_equal(lv, ev)
+        np.testing.assert_array_equal(li, ei)
+    finally:
+        svc.close()
+
+
+def test_no_towers_fallback_counted(hin, metapath):
+    """mode=learned against an exact-only service: served exactly,
+    degradation counted — the router re-dispatch story's local half."""
+    svc = PathSimService(
+        create_backend("numpy", hin, metapath),
+        config=ServeConfig(max_wait_ms=0.5, warm=False),
+    )
+    try:
+        assert svc.stats()["learned"] is None
+        assert svc.learned_fallback_reason(3, "learned") == "no_towers"
+        before = _fallbacks("no_towers")
+        lv, li = svc.topk_index(3, k=5, mode="learned")
+        assert _fallbacks("no_towers") > before
+        ev, ei = svc.topk_index(3, k=5, mode="exact")
+        np.testing.assert_array_equal(lv, ev)
+        np.testing.assert_array_equal(li, ei)
+    finally:
+        svc.close()
+
+
+def test_secondary_metapath_falls_back_counted(hin, metapath):
+    """Towers are keyed to ONE metapath; a per-request secondary
+    metapath in learned mode degrades (counted) to the secondary
+    engine's exact path."""
+    svc = _learned_service(hin, metapath)
+    try:
+        before = _fallbacks("metapath")
+        lv, li = svc.topk_index(2, k=5, mode="learned", metapath="APA")
+        assert _fallbacks("metapath") > before
+        ev, ei = svc.topk_index(2, k=5, mode="exact", metapath="APA")
+        np.testing.assert_array_equal(lv, ev)
+        np.testing.assert_array_equal(li, ei)
+    finally:
+        svc.close()
+
+
+def test_shadow_confidence_gate_trips_and_resets(hin, metapath):
+    """An unreachable recall floor flips the learned arm off (the
+    low_confidence fallback — answers stay exact) and refresh_towers
+    re-arms the gate for the re-embedded towers."""
+    svc = _learned_service(hin, metapath, learned_shadow_every=1,
+                           learned_min_shadow=2,
+                           learned_recall_floor=1.01)
+    try:
+        eligible = np.flatnonzero(np.asarray(svc._learned.d)[: svc.n] > 0)
+        for row in eligible[:6]:
+            svc.topk_index(int(row), k=5, mode="learned")
+        assert (
+            svc.learned_fallback_reason(int(eligible[0]), "learned")
+            == "low_confidence"
+        )
+        before = _fallbacks("low_confidence")
+        lv, li = svc.topk_index(int(eligible[0]), k=5, mode="learned")
+        assert _fallbacks("low_confidence") > before
+        ev, ei = svc.topk_index(int(eligible[0]), k=5, mode="exact")
+        np.testing.assert_array_equal(lv, ev)
+        np.testing.assert_array_equal(li, ei)
+        # shadow evidence described the pre-absorb towers: refresh
+        # clears it and the arm is answerable again
+        svc.refresh_towers()
+        assert svc.learned_fallback_reason(
+            int(eligible[0]), "learned"
+        ) is None
+    finally:
+        svc.close()
+
+
+# -- cold start: a never-seen author, answerable immediately ---------------
+
+
+def test_cold_start_appended_author_end_to_end(hin, metapath):
+    """The acceptance property: append a NEVER-SEEN author (new row +
+    edges in one delta) → answerable in learned mode at once through
+    the counted 'stale' fallback, bit-identical to the exact oracle →
+    one O(Δ) absorb (no retrain, no full re-embed) → answered through
+    the towers proper, still bit-identical — with the cold-start gauge
+    and fallback counters asserted along every edge."""
+    svc = _learned_service(hin, metapath)
+    try:
+        n0 = svc.n  # the appended author's dense row index
+        rng = np.random.default_rng(3)
+        papers = sorted({
+            int(p) for p in rng.integers(0, hin.type_size("paper"), 5)
+        })
+        info = svc.update(dl.DeltaBatch(
+            nodes=(dl.NodeAppend(node_type="author", count=1),),
+            edges=(dl.edge_delta(
+                "author_of", add=[[n0, p] for p in papers]
+            ),),
+        ))
+        assert info["mode"] == "delta"
+        assert info["learned_pending_appends"] == 1
+        assert info["learned_stale_rows"] > 0
+        snap = svc.stats()["learned"]
+        assert snap["pending_appends"] == 1
+        assert snap["cold_start_ratio"] == 0.0
+        assert svc.health()["modes"]["learned"]["pending_appends"] == 1
+
+        # BEFORE any refresh: a real answer, exact, counted
+        assert svc.learned_fallback_reason(n0, "learned") == "stale"
+        before = _fallbacks("stale")
+        lv, li = svc.topk_index(n0, k=6, mode="learned")
+        assert _fallbacks("stale") > before
+        ev, ei = svc.topk_index(n0, k=6, mode="exact")
+        np.testing.assert_array_equal(lv, ev)
+        np.testing.assert_array_equal(li, ei)
+        assert np.isfinite(lv).any(), "cold author must have real hits"
+
+        # one O(Δ) inductive absorb
+        refresh = svc.refresh_towers()
+        assert refresh["appended"] == 1
+        assert refresh["stale_remaining"] == 0
+        assert refresh["pending_appends"] == 0
+        assert refresh["refreshed"] >= info["learned_stale_rows"]
+
+        # AFTER: through the towers, same bytes
+        assert svc.learned_fallback_reason(n0, "learned") is None
+        lv2, li2 = svc.topk_index(n0, k=6, mode="learned")
+        np.testing.assert_array_equal(lv2, ev)
+        np.testing.assert_array_equal(li2, ei)
+        snap2 = svc.stats()["learned"]
+        assert snap2["pending_appends"] == 0
+        assert snap2["cold_start_ratio"] == 1.0
+        assert snap2["appended_seen"] == 1
+    finally:
+        svc.close()
+
+
+# -- checkpoints: fingerprint-keyed, atomically saved, loudly refused ------
+
+
+def test_checkpoint_roundtrip_and_mismatch(hin, metapath, tmp_path):
+    enc, info = train_towers(hin, "APVPA", dim=16, hidden=32, steps=20,
+                             hard_sources=48, hard_k=8,
+                             token=("feedc0de", 0))
+    assert info["hard_pool"] > 0
+    path = str(tmp_path / "towers.npz")
+    save_towers(path, enc, ("feedc0de", 0))
+    enc2, token = load_towers(path, expect_base_fp="feedc0de")
+    assert token == ("feedc0de", 0)
+    assert enc2.dim == enc.dim and enc2.metapath == "APVPA"
+    # identical forward pass bytes after the round trip
+    rng = np.random.default_rng(0)
+    c_rows = rng.random((8, enc.v))
+    d_rows = rng.random(8) + 0.5
+    np.testing.assert_array_equal(
+        enc.embed(c_rows, d_rows), enc2.embed(c_rows, d_rows)
+    )
+    with pytest.raises(TowerMismatch):
+        load_towers(path, expect_base_fp="0000000000000000")
+    # a truncated artifact must refuse, not half-load
+    bad = tmp_path / "broken.npz"
+    bad.write_bytes(open(path, "rb").read()[:100])
+    with pytest.raises((TowerMismatch, ValueError, OSError, KeyError)):
+        load_towers(str(bad))
+
+
+def test_service_boots_from_checkpoint_and_refuses_foreign(
+    hin, metapath, tmp_path
+):
+    donor = _learned_service(hin, metapath)
+    try:
+        path = str(tmp_path / "towers.npz")
+        save_towers(path, donor._learned.encoder,
+                    donor.consistency_token)
+        ev, ei = donor.topk_index(1, k=5, mode="exact")
+    finally:
+        donor.close()
+
+    svc = PathSimService(
+        create_backend("numpy", hin, metapath),
+        config=ServeConfig(
+            max_wait_ms=0.5, warm=False, topk_mode="learned",
+            learned_checkpoint=path, learned_shadow_every=0,
+            learned_auto_refresh=False, learned_cand_mult=32,
+            learned_steps=10,
+        ),
+    )
+    try:
+        snap = svc.stats()["learned"]
+        assert snap is not None and snap["enabled"]
+        lv, li = svc.topk_index(1, k=5, mode="learned")
+        np.testing.assert_array_equal(lv, ev)
+        np.testing.assert_array_equal(li, ei)
+    finally:
+        svc.close()
+
+    # a checkpoint keyed to a DIFFERENT graph: refused at startup, the
+    # service falls back to in-process distillation and still serves
+    foreign = str(tmp_path / "foreign.npz")
+    enc, _ = train_towers(hin, "APVPA", dim=16, hidden=32, steps=10,
+                          hard_sources=32, hard_k=6,
+                          token=("0123456789abcdef", 0))
+    save_towers(foreign, enc, ("0123456789abcdef", 0))
+    svc2 = PathSimService(
+        create_backend("numpy", hin, metapath),
+        config=ServeConfig(
+            max_wait_ms=0.5, warm=False, topk_mode="learned",
+            learned_checkpoint=foreign, learned_shadow_every=0,
+            learned_auto_refresh=False, learned_cand_mult=32,
+            learned_steps=10,
+        ),
+    )
+    try:
+        snap = svc2.stats()["learned"]
+        assert snap is not None, "must retrain after refusing the artifact"
+        assert snap["token"] == list(svc2.consistency_token)
+        lv, li = svc2.topk_index(1, k=5, mode="learned")
+        np.testing.assert_array_equal(lv, ev)
+        np.testing.assert_array_equal(li, ei)
+    finally:
+        svc2.close()
+
+
+def test_encoder_refuses_width_change(hin, metapath):
+    """A contraction-width change (new venue vocabulary moved the
+    feature space) must be reported, never silently mis-embedded."""
+    svc = _learned_service(hin, metapath)
+    try:
+        enc = svc._learned.encoder
+        c = np.zeros((4, enc.v + 3), dtype=np.float64)
+        d = np.ones(4, dtype=np.float64)
+        with pytest.raises(ValueError):
+            enc.features(c, d)
+    finally:
+        svc.close()
+
+
+# -- the --emit-pairs JSONL contract (batch/pairs.py) ----------------------
+
+
+def _write_pairs(path, recs):
+    with open(path, "w", encoding="utf-8") as f:
+        for rec in recs:
+            f.write(json.dumps(rec) + "\n")
+
+
+def test_load_pairs_roundtrip_and_schema_rejections(tmp_path):
+    from distributed_pathsim_tpu.batch.pairs import load_pairs
+
+    path = str(tmp_path / "pairs.jsonl")
+    scores = [0.1, 1.0 / 3.0, 0.7071067811865476]
+    _write_pairs(path, [
+        {"row": i, "col": i + 1, "score": s}
+        for i, s in enumerate(scores)
+    ])
+    rows, cols, got = load_pairs(path)
+    assert rows.tolist() == [0, 1, 2]
+    assert cols.tolist() == [1, 2, 3]
+    np.testing.assert_array_equal(got, np.asarray(scores))  # bitwise
+
+    for bad in (
+        [{"row": 0, "col": 1}],                            # missing field
+        [{"row": 0, "col": 1, "score": 0.5, "extra": 1}],  # drifted field
+        [{"row": 0.5, "col": 1, "score": 0.5}],            # float index
+        [{"row": -1, "col": 1, "score": 0.5}],             # negative
+        [{"row": 0, "col": 1, "score": float("nan")}],     # non-finite
+    ):
+        p = str(tmp_path / "bad.jsonl")
+        with open(p, "w", encoding="utf-8") as f:
+            for rec in bad:
+                f.write(json.dumps(rec) + "\n")
+        with pytest.raises(ValueError):
+            load_pairs(p)
+
+
+def test_emitted_pairs_feed_the_loader(tmp_path):
+    """Producer → consumer round trip: a real campaign's --emit-pairs
+    stream loads, splits, and scores exactly."""
+    from distributed_pathsim_tpu.batch import BatchEngine, run_topk_campaign
+    from distributed_pathsim_tpu.batch.pairs import load_pairs
+
+    base = synthetic_hin(120, 200, 8, seed=7)
+    mp = compile_metapath("APVPA", base.schema)
+    out = tmp_path / "pairs.jsonl"
+    res = run_topk_campaign(
+        BatchEngine(base, mp), 3, emit_pairs=str(out)
+    )
+    rows, cols, scores = load_pairs(str(out))
+    assert rows.size > 0
+    for i in range(0, rows.size, max(rows.size // 40, 1)):
+        hit = np.flatnonzero(res.idxs[rows[i]] == cols[i])
+        assert res.vals[rows[i]][hit[0]] == scores[i]  # bitwise
+
+
+def test_split_pairs_deterministic_by_source(tmp_path):
+    from distributed_pathsim_tpu.batch.pairs import split_pairs
+
+    rows = np.repeat(np.arange(50), 3)
+    tr1, va1 = split_pairs(rows, val_frac=0.2, seed=4)
+    tr2, va2 = split_pairs(rows, val_frac=0.2, seed=4)
+    np.testing.assert_array_equal(tr1, tr2)
+    np.testing.assert_array_equal(va1, va2)
+    assert np.all(tr1 ^ va1)  # a partition, not an overlap
+    # by-source: every pair of one source on the same side
+    for src in np.unique(rows):
+        sides = va1[rows == src]
+        assert sides.all() or not sides.any()
+    tr3, va3 = split_pairs(rows, val_frac=0.2, seed=5)
+    assert not np.array_equal(va1, va3), "seed must move the split"
+    with pytest.raises(ValueError):
+        split_pairs(rows, val_frac=1.0)
+
+
+def test_sample_negatives_avoids_positives_and_diagonal():
+    from distributed_pathsim_tpu.batch.pairs import sample_negatives
+
+    rng = np.random.default_rng(0)
+    rows = rng.integers(0, 30, 200)
+    cols = rng.integers(0, 30, 200)
+    keep = rows != cols
+    rows, cols = rows[keep], cols[keep]
+    nr, nc = sample_negatives(rows, cols, n_nodes=30, ratio=1.0, seed=1)
+    assert nr.size > 0
+    positives = set(zip(rows.tolist(), cols.tolist()))
+    for r, c in zip(nr.tolist(), nc.tolist()):
+        assert r != c
+        assert (r, c) not in positives
+    nr2, nc2 = sample_negatives(rows, cols, n_nodes=30, ratio=1.0, seed=1)
+    np.testing.assert_array_equal(nr, nr2)
+    np.testing.assert_array_equal(nc, nc2)
+
+
+# -- CLI + flags-forward + smoke -------------------------------------------
+
+
+def test_learned_cli_train_and_inspect(tmp_path, capsys):
+    from distributed_pathsim_tpu.cli import main
+
+    out = str(tmp_path / "towers.npz")
+    rc = main([
+        "learned", "train",
+        "--dataset", "synthetic:authors=80,papers=140,venues=6,seed=3",
+        "--out", out, "--steps", "15", "--dim", "8",
+        "--hard-sources", "32", "--hard-k", "6",
+    ])
+    assert rc == 0
+    info = json.loads(capsys.readouterr().out)
+    assert info["dim"] == 8 and os.path.exists(out)
+    rc = main(["learned", "inspect", "--towers", out])
+    assert rc == 0
+    meta = json.loads(capsys.readouterr().out)
+    assert meta["dim"] == 8 and meta["metapath"] == "APVPA"
+    assert meta["base_fp"] == info["token"][0]
+
+
+def test_learned_router_worker_flags_forward():
+    """Router CLI forwards the learned flags to worker children."""
+    from distributed_pathsim_tpu.router.cli import (
+        _worker_argv, build_router_parser,
+    )
+
+    args = build_router_parser().parse_args([
+        "--workers", "2", "--topk-mode", "learned",
+        "--learned-dim", "16", "--learned-cand-mult", "8",
+        "--learned-checkpoint", "/tmp/towers.npz",
+        "--no-learned-refresh",
+    ])
+    argv = _worker_argv(args, 0)
+    assert "--topk-mode" in argv and "learned" in argv
+    assert "--learned-dim" in argv and "16" in argv
+    assert "--learned-cand-mult" in argv and "8" in argv
+    assert "--learned-checkpoint" in argv and "/tmp/towers.npz" in argv
+    assert "--no-learned-refresh" in argv
+
+
+def test_fallback_reason_taxonomy_is_closed():
+    assert set(LEARNED_FALLBACK_REASONS) == {
+        "no_towers", "stale", "uncovered", "degenerate",
+        "low_confidence", "metapath",
+    }
+
+
+def test_bench_learned_smoke():
+    """`make learned-smoke`, wired non-slow (tier-1): score-recall
+    gate at shipped defaults, zero steady-state recompiles, the
+    cold-start exercise end to end, zero shed."""
+    import bench_serving
+
+    result = bench_serving.run_learned_smoke()
+    assert all(result["smoke_checks"].values()), result["smoke_checks"]
+
+
+@pytest.mark.slow
+def test_learned_gate_2048():
+    """The full acceptance gate (ISSUE 19): 2048 authors, shipped
+    default knobs — score-recall ≥ 0.99 via exact rerank, zero
+    steady-state compiles, the cold-start exercise bit-identical."""
+    import bench_serving
+
+    result = bench_serving.run_learned_bench()
+    assert result["recall"]["recall_at_k"] >= 0.99
+    assert result["steady_state_compiles"] == 0
+    cs = result["cold_start"]
+    assert cs["pre_refresh_answer_bit_identical"]
+    assert cs["post_refresh_answer_bit_identical"]
+    assert cs["cold_start_ratio_after_refresh"] == 1.0

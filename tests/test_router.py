@@ -816,3 +816,89 @@ def test_router_ann_failover_to_exact_replica(hin, metapath, oracle):
         router.close()
         for t in transports.values():
             t.runtime.service.close()
+
+
+# -- Learned tier: per-mode epochs in health, tower-less failover ----------
+
+
+@pytest.mark.chaos
+def test_router_learned_failover_to_towerless_replica(hin, metapath, oracle):
+    """ISSUE 19 satellite (mirrors the ann chaos case): kill the only
+    tower-ed worker mid-batch — every in-flight ``mode: learned``
+    request re-dispatches onto the surviving replica (which has no
+    towers at all) and is answered exactly: zero lost requests,
+    answers bit-identical to the single-process oracle, the no_towers
+    fallback counted on the survivor. Also: ``health`` advertises the
+    per-mode index-epoch map, and fleet-stats surfaces it per
+    worker."""
+    inject.install_plan("heartbeat:error:2")
+
+    def _svc(learned: bool):
+        return PathSimService(
+            create_backend("numpy", hin, metapath),
+            config=ServeConfig(
+                max_wait_ms=1.0, warm=False,
+                topk_mode="learned" if learned else "exact",
+                learned_shadow_every=0, learned_auto_refresh=False,
+                # candidate set >= n: learned answers are bit-identical
+                # regardless of what 40 steps taught the towers
+                learned_cand_mult=32, learned_steps=40,
+            ),
+        )
+
+    transports = {
+        "w0": InprocTransport("w0", WorkerRuntime(_svc(True),
+                                                  worker_id="w0")),
+        "w1": InprocTransport("w1", WorkerRuntime(_svc(False),
+                                                  worker_id="w1")),
+    }
+    router = Router(transports, RouterConfig(heartbeat_interval_s=0.05,
+                                             hedge_ms=None))
+    router.start()
+
+    def _no_towers() -> float:
+        from distributed_pathsim_tpu.obs.metrics import get_registry
+
+        return get_registry().counter(
+            "dpathsim_learned_fallbacks_total",
+            "learned-requested queries degraded to ann/exact, by reason",
+        ).labels(reason="no_towers").value
+
+    try:
+        # health advertises the per-mode epoch map (and its absence)
+        h0 = router.worker_health("w0")
+        h1 = router.worker_health("w1")
+        token0 = list(
+            transports["w0"].runtime.service.consistency_token
+        )
+        assert h0["modes"]["learned"]["epoch"] == token0
+        assert h0["modes"]["learned"]["enabled"]
+        assert h0["modes"]["exact"]["epoch"] == token0
+        assert h1["modes"]["learned"] is None
+        assert h1["modes"]["exact"]["enabled"]
+        st = router.stats()["router"]["workers"]
+        assert st["w0"]["modes"]["learned"]["epoch"] == token0
+        assert st["w1"]["modes"]["learned"] is None
+
+        fb0 = _no_towers()
+        futs = [
+            router.submit({"id": i, "op": "topk",
+                           "row": int(i % oracle.n), "k": 5,
+                           "mode": "learned"})
+            for i in range(48)
+        ]
+        transports["w0"].kill()  # the tower-ed replica dies mid-batch
+        resps = [fut.result(timeout=30) for fut in futs]
+        assert all(r["ok"] for r in resps)
+        for i, r in enumerate(resps):
+            assert _got_topk(r) == _oracle_topk(oracle, i % oracle.n, 5)
+        assert router.stats()["router"]["workers"]["w0"]["status"] == "down"
+        # the kill must have orphaned real learned work onto the
+        # survivor, where each answer is a counted no_towers fallback
+        assert sum(1 for r in resps if r.get("failovers")) > 0
+        assert _no_towers() > fb0
+    finally:
+        inject.reset()
+        router.close()
+        for t in transports.values():
+            t.runtime.service.close()
